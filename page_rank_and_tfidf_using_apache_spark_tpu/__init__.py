@@ -1,0 +1,48 @@
+"""TPU-native PageRank + TF-IDF framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of the reference
+``ajak6/Page-Rank-and-TFIDF-using-Apache-Spark`` (a Spark-RDD application;
+see SURVEY.md — the reference checkout was empty at survey time, so parity
+targets are reconstructed from the driver metadata in BASELINE.json and the
+canonical Spark PageRank / TF-IDF programs it fingerprints).
+
+Where the reference expresses PageRank as
+``links.join(ranks).flatMap(computeContribs).reduceByKey(add)`` shuffles over
+RDD partitions (BASELINE.json:5), this framework keeps the graph
+device-resident as sorted edge arrays and runs each iteration as one
+XLA-compiled sparse matvec: ``segment_sum`` for the intra-chip combine,
+``lax.psum`` over ICI for the cross-chip combine and dangling mass.  Where
+the reference's TF-IDF is ``flatMap(tokenize) → reduceByKey`` term-count and
+document-frequency passes, this framework hashes tokens on host into a
+``2**v`` vocabulary and runs both passes as ``segment_sum`` over device
+arrays, with the IDF vector broadcast (replicated) across chips.
+
+Layout (mirrors SURVEY.md §7's build plan):
+
+- ``io/``        host-side ingest: SNAP edge lists → CSR/edge arrays,
+                 corpus loading, tokenization, hashed vocabulary
+- ``ops/``       jittable numeric cores: SpMV-based PageRank step,
+                 segment-sum TF/DF passes, IDF variants
+- ``models/``    user-facing algorithm drivers: PageRank (standard,
+                 personalized, spark-semantics), TF-IDF (batch, streaming)
+- ``parallel/``  mesh construction, shardings, collectives, multi-host init
+- ``utils/``     configs, metrics, checkpointing, profiling, native bindings
+- ``cli/``       argparse drivers mirroring the reference's
+                 ``spark-submit <script> <input> <iters> [output]`` shape
+"""
+
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    PageRankConfig,
+    TfidfConfig,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.api import pagerank, tfidf
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "PageRankConfig",
+    "TfidfConfig",
+    "pagerank",
+    "tfidf",
+    "__version__",
+]

@@ -1,0 +1,69 @@
+"""Library API — the two capabilities of the reference behind two calls.
+
+Reference counterpart: running ``spark-submit pagerank.py`` /
+``spark-submit tfidf.py`` (SURVEY.md A1/A6); here the same surface as
+importable functions, with the CLI drivers (cli/) as thin argv wrappers.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
+from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import (
+    PageRankResult,
+    run_pagerank,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    TfidfOutput,
+    run_tfidf,
+    run_tfidf_streaming,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    PageRankConfig,
+    TfidfConfig,
+)
+
+
+def pagerank(
+    graph: Graph, cfg: PageRankConfig | None = None, **kwargs
+) -> PageRankResult:
+    """Run PageRank on a :class:`Graph`.
+
+    ``pagerank(g)`` reproduces the reference defaults: 20 iterations,
+    damping 0.85, ranks initialized to 1.0, dangling mass dropped
+    (BASELINE.json:7; SURVEY.md §3.1).  Keyword args construct/override the
+    config: ``pagerank(g, iterations=50, dangling="redistribute")``.
+    """
+    if cfg is None:
+        cfg = PageRankConfig(**kwargs)
+    elif kwargs:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, **kwargs)
+    return run_pagerank(graph, cfg)
+
+
+def tfidf(
+    docs: Sequence[str] | Iterable[Sequence[str]],
+    cfg: TfidfConfig | None = None,
+    *,
+    streaming: bool = False,
+    **kwargs,
+) -> TfidfOutput:
+    """Compute TF-IDF over a corpus.
+
+    ``docs`` is a sequence of document strings (batch) or, with
+    ``streaming=True``, an iterable of document chunks (BASELINE.json:11).
+    Defaults match the 20-Newsgroups config: unigrams, hashed vocab 2^18,
+    raw TF, classic ``log(N/df)`` IDF (BASELINE.json:8; SURVEY.md §4).
+    """
+    if cfg is None:
+        cfg = TfidfConfig(**kwargs)
+    elif kwargs:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, **kwargs)
+    if streaming:
+        return run_tfidf_streaming(docs, cfg)
+    return run_tfidf(docs, cfg)

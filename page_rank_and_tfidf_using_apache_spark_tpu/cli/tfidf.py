@@ -1,0 +1,133 @@
+"""TF-IDF CLI — the reference's ``spark-submit tfidf.py <corpus>`` entry
+point (SURVEY.md A6, §2.2 R10).
+
+Usage::
+
+    python -m page_rank_and_tfidf_using_apache_spark_tpu.cli.tfidf \
+        corpus_dir --output weights.tsv --idf-mode classic
+    python -m ...cli.tfidf corpus.txt --lines --streaming --chunk-docs 1000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
+    iter_corpus_chunks,
+    iter_corpus_dir,
+    iter_corpus_lines,
+    load_corpus_dir,
+    load_corpus_lines,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    run_tfidf,
+    run_tfidf_streaming,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.profiling import trace
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tfidf",
+        description="TPU-native TF-IDF over a text corpus (hashed vocabulary).",
+    )
+    p.add_argument("input", help="corpus directory (one doc per file) or flat file")
+    p.add_argument("--lines", action="store_true",
+                   help="input is a flat file with one document per line")
+    p.add_argument("--output", help="write '<doc>\\t<term_id>\\t<weight>' lines here")
+    p.add_argument("--vocab-bits", type=int, default=18)
+    p.add_argument("--ngram", type=int, choices=[1, 2], default=1)
+    p.add_argument("--tf-mode", choices=["raw", "freq", "lognorm"], default="raw")
+    p.add_argument("--idf-mode", choices=["classic", "mllib", "smooth"], default="classic")
+    p.add_argument("--l2-normalize", action="store_true")
+    p.add_argument("--min-token-len", type=int, default=1)
+    p.add_argument("--streaming", action="store_true")
+    p.add_argument("--chunk-docs", type=int, default=1024,
+                   help="docs per streaming chunk")
+    p.add_argument("--chunk-tokens", type=int, default=0,
+                   help="fixed token capacity per chunk (0 = auto)")
+    p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   help="chunks between checkpoints")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--query", nargs="+", default=None, metavar="TERM",
+                   help="score docs against these terms, print top-k")
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--metrics-json")
+    p.add_argument("--profile-dir")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    metrics = MetricsRecorder()
+
+    if args.streaming:
+        # Lazy iteration: the corpus never fully materializes on host.
+        docs = (iter_corpus_lines if args.lines else iter_corpus_dir)(args.input)
+        names: list[str] = []
+    else:
+        docs, names = (load_corpus_lines if args.lines else load_corpus_dir)(args.input)
+    cfg = TfidfConfig(
+        vocab_bits=args.vocab_bits,
+        ngram=args.ngram,
+        tf_mode=args.tf_mode,
+        idf_mode=args.idf_mode,
+        l2_normalize=args.l2_normalize,
+        min_token_len=args.min_token_len,
+        chunk_tokens=args.chunk_tokens,
+        checkpoint_every=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+
+    with trace(args.profile_dir):
+        if args.streaming:
+            out = run_tfidf_streaming(
+                iter_corpus_chunks(docs, args.chunk_docs), cfg,
+                metrics=metrics, resume=args.resume,
+            )
+        else:
+            out = run_tfidf(docs, cfg, metrics=metrics, doc_names=names)
+
+    if args.output:
+        with open(args.output, "w") as f:
+            for d, t, w in zip(out.doc, out.term, out.weight):
+                f.write(f"{names[d] if d < len(names) else d}\t{t}\t{w:.10g}\n")
+
+    if args.query:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
+            fnv1a_64,
+            hash_to_vocab,
+        )
+        from page_rank_and_tfidf_using_apache_spark_tpu.ops.tfidf import TfidfResult, score_query
+
+        q = np.zeros(cfg.vocab_size, np.float32)
+        terms = [t.lower() if cfg.lowercase else t for t in args.query]
+        q[hash_to_vocab(fnv1a_64(terms), cfg.vocab_bits)] = 1.0
+        res = TfidfResult(
+            doc=jnp.asarray(out.doc), term=jnp.asarray(out.term),
+            weight=jnp.asarray(out.weight),
+            n_pairs=jnp.asarray(out.nnz), valid=jnp.ones(out.nnz, jnp.float32),
+            idf=jnp.asarray(out.idf), df=jnp.asarray(out.df),
+        )
+        k = min(args.top_k, max(out.n_docs, 1))
+        scores, idx = score_query(res, jnp.asarray(q), n_docs=max(out.n_docs, 1), k=k)
+        for s, i in zip(scores, idx):
+            if float(s) > 0:
+                print(f"{names[int(i)] if int(i) < len(names) else int(i)}\t{float(s):.10g}")
+
+    print(json.dumps({"docs": out.n_docs, "nnz": out.nnz}), file=sys.stderr)
+    if args.metrics_json:
+        metrics.dump(args.metrics_json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

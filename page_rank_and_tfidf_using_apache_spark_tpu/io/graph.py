@@ -1,0 +1,180 @@
+"""Host-side graph ingest: SNAP edge lists → device-ready edge arrays.
+
+Reference counterpart (SURVEY.md §2.1 A2/A3): the Spark chain
+``sc.textFile(edges).map(parse).distinct().groupByKey().cache()`` — a text
+parse followed by a dedup shuffle and an adjacency-list build kept hot
+across iterations.  TPU-native design: parse once on host into flat numpy
+arrays, dedup with one vectorized sort, and keep the graph device-resident
+as **destination-sorted edge arrays** (a CSC-by-destination layout): the
+per-iteration `reduceByKey` then becomes a `segment_sum` over contiguous
+destination segments, which is the layout XLA tiles best.
+
+SNAP format: ``#``-prefixed comment header lines, whitespace-separated
+integer ``src dst`` pairs (BASELINE.json:7,9 name SNAP web-Google and
+soc-LiveJournal1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Graph:
+    """A directed graph in destination-sorted edge-array form.
+
+    Node ids are compacted to ``[0, n_nodes)``; ``node_ids[i]`` maps row
+    ``i`` back to the original id from the input file (identity when the
+    input was already compact).
+
+    Invariants: ``dst`` is non-decreasing; ``(src, dst)`` pairs are unique
+    (the reference's ``distinct()``); ``out_degree[v] == #edges with
+    src == v``; dangling nodes are exactly ``out_degree == 0``.
+    """
+
+    n_nodes: int
+    src: np.ndarray  # int32 [n_edges], sorted by (dst, src)
+    dst: np.ndarray  # int32 [n_edges], non-decreasing
+    out_degree: np.ndarray  # int32 [n_nodes]
+    node_ids: np.ndarray  # original ids, [n_nodes]
+
+    @property
+    def n_edges(self) -> int:
+        return int(self.src.shape[0])
+
+    @property
+    def dangling_mask(self) -> np.ndarray:
+        return self.out_degree == 0
+
+    def __repr__(self) -> str:  # keep pytest output readable
+        return f"Graph(n_nodes={self.n_nodes}, n_edges={self.n_edges})"
+
+
+def from_edges(
+    src: np.ndarray,
+    dst: np.ndarray,
+    *,
+    dedup: bool = True,
+    drop_self_loops: bool = False,
+    compact_ids: bool = True,
+) -> Graph:
+    """Build a :class:`Graph` from raw (src, dst) id arrays.
+
+    ``dedup=True`` reproduces the reference's ``distinct()``; self-loops are
+    kept by default (``distinct()`` does not remove them).
+    """
+    src = np.asarray(src).ravel()
+    dst = np.asarray(dst).ravel()
+    if src.shape != dst.shape:
+        raise ValueError(f"src/dst shape mismatch: {src.shape} vs {dst.shape}")
+    if drop_self_loops:
+        keep = src != dst
+        src, dst = src[keep], dst[keep]
+
+    if compact_ids:
+        node_ids, inverse = np.unique(np.concatenate([src, dst]), return_inverse=True)
+        src = inverse[: src.shape[0]]
+        dst = inverse[src.shape[0] :]
+        n = int(node_ids.shape[0])
+    else:
+        n = int(max(src.max(initial=-1), dst.max(initial=-1)) + 1) if src.size else 0
+        if n > (1 << 31):
+            raise ValueError(
+                f"compact_ids=False with max id {n - 1}: the O(n) rank/degree "
+                "vectors would not fit; use compact_ids=True"
+            )
+        node_ids = np.arange(n, dtype=np.int64)
+
+    src = src.astype(np.int64)
+    dst = dst.astype(np.int64)
+    # lexsort (dst major, src minor) gives both the dedup order and the
+    # final destination-sorted layout; unlike a dst*n+src composite key it
+    # cannot overflow for large raw ids under compact_ids=False.
+    order = np.lexsort((src, dst))
+    src, dst = src[order], dst[order]
+    if dedup and src.size:
+        keep = np.empty(src.shape, dtype=bool)
+        keep[0] = True
+        keep[1:] = (src[1:] != src[:-1]) | (dst[1:] != dst[:-1])
+        src, dst = src[keep], dst[keep]
+
+    out_degree = np.bincount(src, minlength=n).astype(np.int32)
+    return Graph(
+        n_nodes=n,
+        src=src.astype(np.int32),
+        dst=dst.astype(np.int32),
+        out_degree=out_degree,
+        node_ids=node_ids,
+    )
+
+
+def parse_snap_text(text: str | bytes, **kwargs) -> Graph:
+    """Parse SNAP edge-list text (``#`` comments, whitespace-separated int
+    pairs). Vectorized: one pass to strip comments, one ``split`` for all
+    tokens."""
+    if isinstance(text, bytes):
+        text = text.decode("utf-8", errors="replace")
+    data_lines = [ln for ln in text.splitlines() if ln and not ln.lstrip().startswith("#")]
+    if not data_lines:
+        return from_edges(np.empty(0, np.int64), np.empty(0, np.int64), **kwargs)
+    flat = " ".join(data_lines).split()
+    arr = np.array(flat, dtype=np.int64)
+    if arr.size % 2 != 0:
+        raise ValueError(f"edge list has odd token count {arr.size}; not (src, dst) pairs")
+    pairs = arr.reshape(-1, 2)
+    return from_edges(pairs[:, 0], pairs[:, 1], **kwargs)
+
+
+def load_snap(path: str, **kwargs) -> Graph:
+    """Load a SNAP-format edge-list file.
+
+    Uses the native C++ parser (utils/native.py) when available — the pure
+    python tokenize of a 69M-edge soc-LiveJournal1 file is the kind of host
+    bottleneck SURVEY.md §7 flags — falling back to the numpy path.
+    """
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils import native
+
+    pairs = native.parse_edge_file(path)
+    if pairs is not None:
+        return from_edges(pairs[:, 0], pairs[:, 1], **kwargs)
+    with open(path, "rb") as f:
+        return parse_snap_text(f.read(), **kwargs)
+
+
+def save_ranks(path: str, graph: Graph, ranks: np.ndarray, *, top_k: int | None = None) -> None:
+    """Write ``<original_node_id>\\t<rank>`` lines, highest rank first —
+    the reference's ``saveAsTextFile`` of collected ranks (SURVEY.md A5)."""
+    order = np.argsort(-ranks, kind="stable")
+    if top_k is not None:
+        order = order[:top_k]
+    with open(path, "w") as f:
+        for i in order:
+            f.write(f"{graph.node_ids[i]}\t{ranks[i]:.10g}\n")
+
+
+def synthetic_powerlaw(
+    n_nodes: int,
+    n_edges: int,
+    *,
+    seed: int = 0,
+    zipf_a: float = 1.5,
+) -> Graph:
+    """Synthetic graph with a power-law in-degree distribution.
+
+    Stand-in for the SNAP datasets (not mounted in this environment —
+    BASELINE.md); matches their shape class: heavy-tailed degrees, dangling
+    nodes, duplicate edges before dedup.  Sources uniform, destinations
+    Zipf-distributed over a random permutation so "celebrity" nodes exist —
+    the load-imbalance stressor SURVEY.md §7 calls out for sharded SpMV.
+    """
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, n_nodes, size=n_edges, dtype=np.int64)
+    # Zipf over ranks, clipped to [0, n_nodes), then scattered via a random
+    # permutation so hot nodes are not all small ids.
+    z = rng.zipf(zipf_a, size=n_edges) - 1
+    z = np.minimum(z, n_nodes - 1)
+    perm = rng.permutation(n_nodes)
+    dst = perm[z]
+    return from_edges(src, dst)

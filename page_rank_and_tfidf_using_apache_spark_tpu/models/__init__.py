@@ -1,0 +1,17 @@
+from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import (
+    PageRankResult,
+    run_pagerank,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    TfidfOutput,
+    run_tfidf,
+    run_tfidf_streaming,
+)
+
+__all__ = [
+    "PageRankResult",
+    "run_pagerank",
+    "TfidfOutput",
+    "run_tfidf",
+    "run_tfidf_streaming",
+]

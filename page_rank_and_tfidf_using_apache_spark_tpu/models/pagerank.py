@@ -1,0 +1,113 @@
+"""PageRank model driver: orchestration, checkpointing, metrics.
+
+Reference counterpart (SURVEY.md A1/A4/A5): the ``pagerank.py`` driver —
+``main(argv)`` building the graph, running the ``for i in range(iters)``
+loop, collecting ranks.  Here the driver's only jobs are host-side: move the
+graph to device once, launch the compiled loop, periodically snapshot state,
+and emit structured per-segment metrics (SURVEY.md §5.5).  The numeric loop
+itself is ops/pagerank.py, compiled to a single XLA program.
+
+Checkpointing (SURVEY.md §5.3/§5.4): with ``checkpoint_every = k`` the run
+executes in k-iteration compiled segments with an atomic snapshot of
+``(ranks, iteration, config_hash)`` between segments — recovery is
+restart-from-snapshot (there is no lineage to replay on TPU), exercised by
+the kill/resume fault-injection test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
+from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
+
+
+@dataclasses.dataclass(frozen=True)
+class PageRankResult:
+    ranks: np.ndarray  # f[n_nodes], aligned with graph's compacted ids
+    iterations: int  # iterations actually executed
+    l1_delta: float  # L1 delta of the final iteration
+    metrics: MetricsRecorder
+
+
+def run_pagerank(
+    graph: Graph,
+    cfg: PageRankConfig,
+    *,
+    metrics: MetricsRecorder | None = None,
+    resume: bool = False,
+) -> PageRankResult:
+    """Run PageRank per ``cfg`` on the default device (single-chip path;
+    the sharded multi-chip path is parallel/pagerank_sharded.py)."""
+    metrics = metrics or MetricsRecorder()
+    n = graph.n_nodes
+    if n == 0:
+        return PageRankResult(np.zeros(0, cfg.dtype), 0, 0.0, metrics)
+
+    dg = ops.put_graph(graph, cfg.dtype)
+    e = jax.device_put(ops.restart_vector(n, cfg))
+    ranks = np.asarray(ops.init_ranks(n, cfg))
+    start_iter = 0
+
+    if resume:
+        if not cfg.checkpoint_dir:
+            raise ValueError("resume=True requires checkpoint_dir")
+        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+        if latest is not None:
+            start_iter, arrays, _ = ckpt.load_checkpoint(latest, cfg.config_hash())
+            ranks = arrays["ranks"]
+            metrics.record(event="resume", path=latest, start_iter=start_iter)
+
+    ranks_dev = jax.device_put(ranks.astype(cfg.dtype))
+
+    make = ops.make_spark_exact_runner if cfg.spark_exact else ops.make_pagerank_runner
+    remaining = cfg.iterations - start_iter
+    segment = (
+        cfg.checkpoint_every
+        if (cfg.checkpoint_every > 0 and not cfg.spark_exact and cfg.tol == 0.0)
+        else remaining
+    )
+
+    done = start_iter
+    last_delta = float("inf")
+    while done < cfg.iterations:
+        todo = min(segment, cfg.iterations - done)
+        seg_cfg = dataclasses.replace(
+            cfg, iterations=todo, checkpoint_every=0, checkpoint_dir=None
+        )
+        runner = make(n, seg_cfg)
+        with Timer() as t:
+            ranks_dev, iters, delta = runner(dg, ranks_dev, e)
+            ranks_dev.block_until_ready()
+        done += int(iters)
+        last_delta = float(delta)
+        metrics.record(
+            iter=done,
+            l1_delta=last_delta,
+            secs=t.elapsed,
+            iters_per_sec=int(iters) / t.elapsed if t.elapsed > 0 else float("inf"),
+        )
+        if cfg.checkpoint_every > 0 and cfg.checkpoint_dir and done < cfg.iterations:
+            path = ckpt.save_checkpoint(
+                cfg.checkpoint_dir,
+                done,
+                {"ranks": np.asarray(ranks_dev)},
+                cfg.config_hash(),
+            )
+            metrics.record(event="checkpoint", path=path, iter=done)
+        if cfg.tol > 0.0 and last_delta <= cfg.tol:
+            break
+        if todo == remaining and cfg.tol > 0.0:
+            break  # while_loop runner already handled tol internally
+
+    metrics.scalar("iterations", done)
+    metrics.scalar("l1_delta", last_delta)
+    return PageRankResult(
+        ranks=np.asarray(ranks_dev), iterations=done, l1_delta=last_delta, metrics=metrics
+    )

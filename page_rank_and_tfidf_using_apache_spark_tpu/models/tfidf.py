@@ -1,0 +1,243 @@
+"""TF-IDF model drivers: batch and streaming ingest.
+
+Reference counterpart (SURVEY.md A6–A10, §3.2): the ``tfidf.py`` Spark
+driver — tokenize/flatMap, TF and DF reduceByKey passes, IDF, join, save.
+The batch path here is one device pipeline call; the streaming path
+(BASELINE.json:11 "English Wikipedia ~6M docs, streaming ingest") feeds
+fixed-shape token chunks through a once-compiled kernel, accumulating the
+DF vector and doc count on device and spilling per-chunk TF counts to host,
+then applies IDF in a second pass — the two-pass structure Spark gets from
+its separate TF and DF shuffles, minus the shuffles.
+
+Checkpointing (SURVEY.md §5.4): every ``checkpoint_every`` chunks the
+accumulated ``(df, n_docs, chunk_index, tf-counts-so-far)`` state is
+snapshotted atomically; resume skips already-ingested chunks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Iterator, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
+from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
+
+
+@dataclasses.dataclass(frozen=True)
+class TfidfOutput:
+    """Host-side sparse TF-IDF matrix in COO form, sorted by (term, doc),
+    plus the dense DF/IDF tables — the reference's saved A10 output."""
+
+    n_docs: int
+    vocab_bits: int
+    doc: np.ndarray  # int32 [nnz]
+    term: np.ndarray  # int32 [nnz]
+    weight: np.ndarray  # f[nnz]
+    df: np.ndarray  # f[vocab]
+    idf: np.ndarray  # f[vocab]
+    metrics: MetricsRecorder
+
+    @property
+    def nnz(self) -> int:
+        return int(self.doc.shape[0])
+
+    def to_dense(self) -> np.ndarray:
+        """[n_docs, vocab] dense matrix — tests/small corpora only."""
+        out = np.zeros((self.n_docs, 1 << self.vocab_bits), dtype=self.weight.dtype)
+        out[self.doc, self.term] = self.weight
+        return out
+
+
+def run_tfidf(
+    docs: Sequence[str],
+    cfg: TfidfConfig,
+    *,
+    metrics: MetricsRecorder | None = None,
+    doc_names: Sequence[str] | None = None,
+) -> TfidfOutput:
+    """Batch TF-IDF: tokenize on host, one compiled device pipeline."""
+    metrics = metrics or MetricsRecorder()
+    with Timer() as t_tok:
+        corpus = tio.tokenize_corpus(
+            docs,
+            vocab_bits=cfg.vocab_bits,
+            ngram=cfg.ngram,
+            lowercase=cfg.lowercase,
+            min_token_len=cfg.min_token_len,
+            doc_names=doc_names,
+        )
+    metrics.record(event="tokenize", docs=corpus.n_docs, tokens=corpus.n_tokens, secs=t_tok.elapsed)
+
+    with Timer() as t_dev:
+        result = ops.tfidf_pipeline(
+            jnp.asarray(corpus.doc_ids),
+            jnp.asarray(corpus.term_ids),
+            jnp.asarray(corpus.doc_lengths),
+            n_docs=max(corpus.n_docs, 1),
+            vocab=cfg.vocab_size,
+            tf_mode=cfg.tf_mode,
+            idf_mode=cfg.idf_mode,
+            l2_normalize=cfg.l2_normalize,
+        )
+        jax.block_until_ready(result)
+    n_pairs = int(result.n_pairs)
+    metrics.record(
+        event="pipeline", pairs=n_pairs, secs=t_dev.elapsed,
+        tokens_per_sec=corpus.n_tokens / t_dev.elapsed if t_dev.elapsed > 0 else float("inf"),
+    )
+    return TfidfOutput(
+        n_docs=corpus.n_docs,
+        vocab_bits=cfg.vocab_bits,
+        doc=np.asarray(result.doc[:n_pairs]),
+        term=np.asarray(result.term[:n_pairs]),
+        weight=np.asarray(result.weight[:n_pairs]),
+        df=np.asarray(result.df),
+        idf=np.asarray(result.idf),
+        metrics=metrics,
+    )
+
+
+def _pad_chunk(
+    corpus: tio.TokenizedCorpus, cap: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    t = corpus.n_tokens
+    doc_ids = np.zeros(cap, np.int32)
+    term_ids = np.zeros(cap, np.int32)
+    valid = np.zeros(cap, bool)
+    doc_ids[:t] = corpus.doc_ids
+    term_ids[:t] = corpus.term_ids
+    valid[:t] = True
+    return doc_ids, term_ids, valid
+
+
+def run_tfidf_streaming(
+    doc_chunks: Iterable[Sequence[str]],
+    cfg: TfidfConfig,
+    *,
+    metrics: MetricsRecorder | None = None,
+    resume: bool = False,
+) -> TfidfOutput:
+    """Streaming TF-IDF over an iterator of document chunks.
+
+    Documents never span chunks, so per-chunk run-length DF increments add
+    up to the exact global DF.  Chunk token arrays are padded to a fixed
+    capacity (``cfg.chunk_tokens``, or the first chunk's size rounded up to
+    a power of two) so the device kernel compiles once; an oversized chunk
+    bumps the capacity with a logged recompile (SURVEY.md §7).
+    """
+    metrics = metrics or MetricsRecorder()
+    vocab = cfg.vocab_size
+    dtype = cfg.dtype
+
+    df_total = np.zeros(vocab, dtype)
+    n_docs = 0
+    chunk_index = 0
+    parts: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []  # (doc, term, count)
+    doc_length_parts: list[np.ndarray] = []
+    cap = cfg.chunk_tokens
+
+    if resume:
+        if not cfg.checkpoint_dir:
+            raise ValueError("resume=True requires checkpoint_dir")
+        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+        if latest is not None:
+            chunk_index, arrays, extra = ckpt.load_checkpoint(latest, cfg.config_hash())
+            df_total = arrays["df"]
+            n_docs = int(extra["n_docs"])
+            parts = [(arrays["doc"], arrays["term"], arrays["count"])]
+            doc_length_parts = [arrays["doc_lengths"]]
+            metrics.record(event="resume", path=latest, chunk=chunk_index, docs=n_docs)
+
+    for i, docs in enumerate(doc_chunks):
+        if i < chunk_index:
+            continue  # already ingested before the resume point
+        corpus = tio.tokenize_corpus(
+            docs,
+            vocab_bits=cfg.vocab_bits,
+            ngram=cfg.ngram,
+            lowercase=cfg.lowercase,
+            min_token_len=cfg.min_token_len,
+            doc_id_offset=n_docs,
+        )
+        if cap <= 0:
+            cap = 1 << max(10, int(np.ceil(np.log2(max(corpus.n_tokens, 1)))))
+        while corpus.n_tokens > cap:
+            cap *= 2
+            metrics.record(event="chunk_cap_bump", cap=cap, chunk=i)
+        doc_ids, term_ids, valid = _pad_chunk(corpus, cap)
+        with Timer() as t:
+            counts, df_inc = ops.chunk_counts(
+                jnp.asarray(doc_ids), jnp.asarray(term_ids), jnp.asarray(valid), vocab=vocab
+            )
+            jax.block_until_ready((counts, df_inc))
+        k = int(counts.n_pairs)
+        parts.append(
+            (np.asarray(counts.doc[:k]), np.asarray(counts.term[:k]), np.asarray(counts.count[:k]))
+        )
+        doc_length_parts.append(corpus.doc_lengths)
+        df_total = df_total + np.asarray(df_inc, dtype)
+        n_docs += corpus.n_docs
+        chunk_index = i + 1
+        metrics.record(
+            event="chunk", chunk=i, docs=n_docs, tokens=corpus.n_tokens,
+            pairs=k, secs=t.elapsed,
+        )
+        if cfg.checkpoint_every > 0 and cfg.checkpoint_dir and chunk_index % cfg.checkpoint_every == 0:
+            doc_a, term_a, count_a = (np.concatenate(x) for x in zip(*parts))
+            parts = [(doc_a, term_a, count_a)]
+            doc_length_parts = [np.concatenate(doc_length_parts)]
+            path = ckpt.save_checkpoint(
+                cfg.checkpoint_dir,
+                chunk_index,
+                {
+                    "df": df_total, "doc": doc_a, "term": term_a, "count": count_a,
+                    "doc_lengths": doc_length_parts[0],
+                },
+                cfg.config_hash(),
+                extra={"n_docs": n_docs},
+            )
+            metrics.record(event="checkpoint", path=path, chunk=chunk_index)
+
+    if not parts:
+        z = np.zeros(0, np.int32)
+        return TfidfOutput(0, cfg.vocab_bits, z, z, np.zeros(0, dtype),
+                           df_total, np.zeros(vocab, dtype), metrics)
+
+    doc_a = np.concatenate([p[0] for p in parts])
+    term_a = np.concatenate([p[1] for p in parts])
+    count_a = np.concatenate([p[2] for p in parts]).astype(dtype)
+    doc_lengths = np.concatenate(doc_length_parts)
+
+    # Second pass: IDF join + weights, in numpy (the per-pair math is
+    # elementwise; the heavy segment reductions already ran on device).
+    idf = np.asarray(
+        ops.idf_vector(jnp.asarray(df_total), float(max(n_docs, 1)), cfg.idf_mode)
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfMode
+
+    if cfg.tf_mode is TfMode.RAW:
+        tf = count_a
+    elif cfg.tf_mode is TfMode.FREQ:
+        tf = count_a / np.maximum(doc_lengths[doc_a].astype(dtype), 1.0)
+    else:  # LOGNORM
+        tf = np.where(count_a > 0, 1.0 + np.log(count_a), 0.0).astype(dtype)
+    weight = tf * idf[term_a]
+    if cfg.l2_normalize:
+        sq = np.zeros(n_docs, dtype)
+        np.add.at(sq, doc_a, weight * weight)
+        weight = weight / np.sqrt(np.maximum(sq, 1e-30))[doc_a]
+
+    metrics.scalar("n_docs", n_docs)
+    metrics.scalar("nnz", int(doc_a.shape[0]))
+    return TfidfOutput(
+        n_docs=n_docs, vocab_bits=cfg.vocab_bits,
+        doc=doc_a, term=term_a, weight=weight.astype(dtype),
+        df=df_total, idf=idf, metrics=metrics,
+    )

@@ -1,0 +1,263 @@
+"""PageRank numeric core: one XLA program per iteration loop.
+
+Reference counterpart (SURVEY.md §3.1, BASELINE.json:5): the per-iteration
+Spark chain ``links.join(ranks).flatMap(computeContribs).reduceByKey(add)
+.mapValues(0.15 + 0.85*r)`` — two shuffle stages per iteration, scheduled by
+the DAGScheduler, executed as per-record iterator chains.
+
+TPU-native design: the whole iteration is one sparse matvec plus an axpy —
+``contribs = Aᵀ · (ranks / outdeg)``; ``ranks' = base + d·(contribs [+
+dangling])`` — expressed as a gather + ``segment_sum`` over destination-
+sorted edges (the `reduceByKey` becomes a contiguous segmented reduction the
+MXU/VPU pipeline, not a shuffle), and the *entire loop* lives inside one
+``jit``-compiled ``lax.scan`` / ``lax.while_loop``: zero host round-trips
+between iterations, XLA fuses the damping/axpy/delta into the reduction's
+epilogue.
+
+Semantics flags (SURVEY.md §3.1 dangling-node caveat):
+- ``dangling=drop``        mass at out-degree-0 nodes vanishes (canonical
+                           Spark example behavior).
+- ``dangling=redistribute`` dangling mass re-spread over the restart
+                           distribution (textbook/networkx behavior; keeps
+                           ``sum(ranks)`` invariant).
+- ``spark_exact``          additionally reproduces the example's shrinking
+                           key-set: nodes that receive no contribution drop
+                           out of the rank table entirely (rank 0, and they
+                           stop contributing even if they have out-links).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    DanglingMode,
+    PageRankConfig,
+    RankInit,
+)
+
+
+class DeviceGraph(NamedTuple):
+    """Device-resident graph state (the reference's ``links.cache()`` —
+    SURVEY.md A3: built once, reused across all iterations)."""
+
+    src: jax.Array  # int32 [E], edge sources, dst-sorted order
+    dst: jax.Array  # int32 [E], non-decreasing
+    inv_outdeg: jax.Array  # f[N], 1/out_degree (0 at dangling nodes)
+    dangling: jax.Array  # f[N], 1.0 where out_degree == 0
+    has_outlinks: jax.Array  # f[N], 1.0 where out_degree > 0
+
+
+def put_graph(graph: Graph, dtype: str = "float32") -> DeviceGraph:
+    """Host Graph → device arrays (one host→device transfer per run)."""
+    outdeg = graph.out_degree.astype(dtype)
+    with np.errstate(divide="ignore"):
+        inv = np.where(outdeg > 0, 1.0 / np.maximum(outdeg, 1), 0.0).astype(dtype)
+    return DeviceGraph(
+        src=jnp.asarray(graph.src),
+        dst=jnp.asarray(graph.dst),
+        inv_outdeg=jnp.asarray(inv),
+        dangling=jnp.asarray((graph.out_degree == 0).astype(dtype)),
+        has_outlinks=jnp.asarray((graph.out_degree > 0).astype(dtype)),
+    )
+
+
+def restart_vector(n: int, cfg: PageRankConfig) -> np.ndarray:
+    """The teleport distribution e: uniform for standard PageRank, an
+    indicator over the source set for personalized PageRank
+    (BASELINE.json:10; SURVEY.md §3.4)."""
+    dtype = cfg.dtype
+    if cfg.personalize is None:
+        return np.full(n, 1.0 / n, dtype=dtype)
+    e = np.zeros(n, dtype=dtype)
+    idx = np.asarray(cfg.personalize, dtype=np.int64)
+    if idx.size == 0:
+        raise ValueError("personalize must name at least one node")
+    if (idx < 0).any() or (idx >= n).any():
+        raise ValueError(f"personalize node ids out of range [0, {n})")
+    # np.add.at so duplicate ids accumulate — e must always sum to 1.
+    np.add.at(e, idx, 1.0 / idx.size)
+    return e
+
+
+def init_ranks(n: int, cfg: PageRankConfig) -> np.ndarray:
+    if cfg.init is RankInit.ONE:
+        return np.ones(n, dtype=cfg.dtype)
+    return np.full(n, 1.0 / n, dtype=cfg.dtype)
+
+
+def spmv_segment(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
+    """contribs[v] = Σ_{(u,v)∈E} weighted_ranks[u] via sorted segment_sum —
+    the `reduceByKey(add)` of BASELINE.json:5 as one segmented reduction."""
+    per_edge = weighted_ranks[dg.src]
+    return jax.ops.segment_sum(
+        per_edge, dg.dst, num_segments=n, indices_are_sorted=True
+    )
+
+
+def spmv_bcoo(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
+    """Same contraction through jax.experimental.sparse.BCOO (the
+    BASELINE.json:5 prescription) — kept as a benchmarked alternative."""
+    from jax.experimental import sparse
+
+    ones = jnp.ones_like(weighted_ranks, shape=dg.src.shape)
+    mat = sparse.BCOO(
+        (ones, jnp.stack([dg.dst, dg.src], axis=1)),
+        shape=(n, n),
+        indices_sorted=True,
+        unique_indices=True,
+    )
+    return mat @ weighted_ranks
+
+
+def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
+    if impl == "segment":
+        return spmv_segment(dg, weighted, n)
+    if impl == "bcoo":
+        return spmv_bcoo(dg, weighted, n)
+    if impl == "pallas":
+        try:
+            from page_rank_and_tfidf_using_apache_spark_tpu.ops.pallas_kernels import (
+                spmv_pallas,
+            )
+        except ImportError as exc:  # pragma: no cover
+            raise NotImplementedError(
+                "spmv_impl='pallas' requires ops/pallas_kernels.py, which is "
+                "not present in this build; use 'segment' or 'bcoo'"
+            ) from exc
+
+        return spmv_pallas(dg.src, dg.dst, weighted, n)
+    raise ValueError(f"unknown spmv impl {impl!r}")
+
+
+def pagerank_step(
+    ranks: jax.Array,
+    dg: DeviceGraph,
+    e: jax.Array,
+    *,
+    n: int,
+    damping: float,
+    dangling: DanglingMode,
+    total_mass: float,
+    impl: str = "segment",
+) -> jax.Array:
+    """One power-iteration step.
+
+    ``total_mass`` is the invariant rank-vector sum: ``n`` under the Spark
+    init=ONE convention (uniform restart term is then the familiar constant
+    0.15), ``1.0`` under the textbook init=UNIFORM convention (restart term
+    (1-d)/n).  The restart distribution ``e`` always sums to 1; both the
+    restart and the redistributed dangling mass are spread according to it,
+    so under dangling=redistribute ``sum(ranks) == total_mass`` is exactly
+    preserved every step.
+    """
+    weighted = ranks * dg.inv_outdeg
+    contribs = _spmv(dg, weighted, n, impl)
+    if dangling is DanglingMode.REDISTRIBUTE:
+        # lost mass re-enters through the restart distribution e; on a
+        # sharded mesh this sum is the lax.psum of BASELINE.json:5.
+        dangling_mass = jnp.sum(ranks * dg.dangling)
+        contribs = contribs + dangling_mass * e
+    base = (1.0 - damping) * total_mass * e
+    return base + damping * contribs
+
+
+class SparkExactState(NamedTuple):
+    """Carry for exact canonical-Spark-example emulation: the rank table's
+    key set shrinks to nodes that received contributions (SURVEY.md §3.1)."""
+
+    ranks: jax.Array  # f[N]; value only meaningful where present == 1
+    present: jax.Array  # f[N]; 1.0 if node currently in the rank table
+
+
+def spark_exact_step(
+    state: SparkExactState, dg: DeviceGraph, *, n: int, damping: float, impl: str = "segment"
+) -> SparkExactState:
+    weighted = state.ranks * state.present * dg.inv_outdeg
+    contribs = _spmv(dg, weighted, n, impl)
+    # A node re-enters the table iff some present source with out-links
+    # points at it (join emits ≥1 record for it).
+    received = _spmv(dg, state.present * dg.has_outlinks, n, impl)
+    present = (received > 0).astype(state.ranks.dtype)
+    ranks = present * ((1.0 - damping) + damping * contribs)
+    return SparkExactState(ranks=ranks, present=present)
+
+
+def make_pagerank_runner(n: int, cfg: PageRankConfig):
+    """Compile the full iteration loop into one XLA program.
+
+    Returns ``run(dg, ranks0, e) -> (ranks, iters_done, final_delta)``.
+    Fixed-iteration runs use ``lax.scan`` (XLA unrolls the loop body once and
+    reuses it); tolerance runs use ``lax.while_loop`` carrying the L1 delta.
+    The Python-side driver loop of the reference (SURVEY.md §3.1 🔥 outer
+    loop) disappears entirely — there are no host round-trips between
+    iterations.
+    """
+    damping = cfg.damping
+    impl = cfg.spmv_impl
+    dangling = cfg.dangling
+    total_mass = float(n) if cfg.init is RankInit.ONE else 1.0
+
+    def step_fn(ranks: jax.Array, dg: DeviceGraph, e: jax.Array) -> jax.Array:
+        return pagerank_step(
+            ranks, dg, e,
+            n=n, damping=damping, dangling=dangling,
+            total_mass=total_mass, impl=impl,
+        )
+
+    if cfg.tol > 0.0:
+
+        @jax.jit
+        def run(dg: DeviceGraph, ranks0: jax.Array, e: jax.Array):
+            def cond(carry):
+                _, delta, it = carry
+                return jnp.logical_and(delta > cfg.tol, it < cfg.iterations)
+
+            def body(carry):
+                ranks, _, it = carry
+                new = step_fn(ranks, dg, e)
+                return new, jnp.sum(jnp.abs(new - ranks)), it + 1
+
+            init = (ranks0, jnp.array(jnp.inf, ranks0.dtype), jnp.array(0, jnp.int32))
+            ranks, delta, it = jax.lax.while_loop(cond, body, init)
+            return ranks, it, delta
+
+        return run
+
+    @jax.jit
+    def run(dg: DeviceGraph, ranks0: jax.Array, e: jax.Array):
+        def body(ranks, _):
+            new = step_fn(ranks, dg, e)
+            return new, jnp.sum(jnp.abs(new - ranks))
+
+        ranks, deltas = jax.lax.scan(body, ranks0, None, length=cfg.iterations)
+        last = deltas[-1] if cfg.iterations > 0 else jnp.array(jnp.inf, ranks0.dtype)
+        return ranks, jnp.array(cfg.iterations, jnp.int32), last
+
+    return run
+
+
+def make_spark_exact_runner(n: int, cfg: PageRankConfig):
+    """Runner for spark_exact mode (always fixed iterations, like the
+    reference's ``for i in range(iters)`` driver loop)."""
+
+    @jax.jit
+    def run(dg: DeviceGraph, ranks0: jax.Array, e: jax.Array):
+        del e  # spark_exact is never personalized
+        state0 = SparkExactState(ranks=ranks0, present=dg.has_outlinks)
+
+        def body(state, _):
+            new = spark_exact_step(state, dg, n=n, damping=cfg.damping, impl=cfg.spmv_impl)
+            delta = jnp.sum(jnp.abs(new.ranks - state.ranks))
+            return new, delta
+
+        state, deltas = jax.lax.scan(body, state0, None, length=cfg.iterations)
+        last = deltas[-1] if cfg.iterations > 0 else jnp.array(jnp.inf, ranks0.dtype)
+        return state.ranks, jnp.array(cfg.iterations, jnp.int32), last
+
+    return run

@@ -1,0 +1,207 @@
+"""TF-IDF numeric core: hashed-vocabulary TF / DF / weight passes on device.
+
+Reference counterpart (SURVEY.md §3.2, BASELINE.json:5): Spark's
+``flatMap(tokenize) → reduceByKey`` term-count pass, the ``distinct →
+reduceByKey`` document-frequency pass, and the ``tf.join(idf)`` weight join
+— three shuffles over ((term, doc), count) records.
+
+TPU-native design: tokens arrive as flat hashed ``(doc_id, term_id)`` int32
+arrays (io/text.py).  Both `reduceByKey` passes become **one sort + one
+run-length encoding**: sort tokens by the composite key ``term·D + doc``;
+each maximal run of equal keys is one (term, doc) pair, so
+
+- TF  = run lengths                       (``segment_sum`` of ones over runs)
+- DF  = number of runs per term           (``segment_sum`` of run-starts)
+- the tf·idf "join" = a gather of ``idf[term]`` into each run
+
+All shapes are static (outputs padded to ``n_tokens`` with a validity mask),
+so the whole pipeline is one ``jit``-compiled XLA program per (n_tokens,
+vocab) shape — the streaming ingest path (models/tfidf.py) feeds fixed-size
+chunks precisely so this compiles once (SURVEY.md §7 "fixed shapes under
+jit").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import IdfMode, TfMode
+
+
+class SparseCounts(NamedTuple):
+    """Padded COO of per-(doc, term) counts — the materialized result of the
+    reference's TF `reduceByKey`.  Rows ``[0, n_pairs)`` are valid, sorted by
+    (term, doc); the padding tail repeats harmless zeros."""
+
+    doc: jax.Array  # int32 [cap]
+    term: jax.Array  # int32 [cap]
+    count: jax.Array  # f[cap]
+    n_pairs: jax.Array  # int32 scalar — number of valid rows
+    valid: jax.Array  # f[cap] — 1.0 for valid rows
+
+
+class TfidfResult(NamedTuple):
+    """Sparse per-(doc, term) TF-IDF weights + the dense IDF vector (the
+    reference's joined A10 output plus the broadcast IDF table R3)."""
+
+    doc: jax.Array  # int32 [cap]
+    term: jax.Array  # int32 [cap]
+    weight: jax.Array  # f[cap]
+    n_pairs: jax.Array  # int32 scalar
+    valid: jax.Array  # f[cap]
+    idf: jax.Array  # f[vocab]
+    df: jax.Array  # f[vocab]
+
+
+def count_pairs(
+    doc_ids: jax.Array,
+    term_ids: jax.Array,
+    *,
+    token_valid: jax.Array | None = None,
+) -> SparseCounts:
+    """The TF pass: ((term, doc), 1) → reduceByKey(add), as sort + RLE.
+
+    ``token_valid`` masks padding tokens (streaming chunks); masked tokens
+    sort to a sentinel key past every real pair and are excluded.
+    """
+    cap = doc_ids.shape[0]
+    dtype = jnp.float32
+    if cap == 0:  # empty corpus/chunk: keep every downstream shape valid
+        zf = jnp.zeros(0, dtype)
+        zi = jnp.zeros(0, jnp.int32)
+        return SparseCounts(doc=zi, term=zi, count=zf, n_pairs=jnp.array(0, jnp.int32), valid=zf)
+    # Lexicographic (valid-first, term-major, doc-minor) sort — avoids a
+    # composite int key, which would overflow int32 at vocab 2^18 × many docs.
+    if token_valid is not None:
+        order = jnp.lexsort((doc_ids, term_ids, ~token_valid))
+    else:
+        order = jnp.lexsort((doc_ids, term_ids))
+    doc_s = doc_ids[order]
+    term_s = term_ids[order]
+    tok_valid_s = (
+        token_valid[order] if token_valid is not None else jnp.ones(cap, dtype=bool)
+    )
+
+    changed = jnp.logical_or(term_s[1:] != term_s[:-1], doc_s[1:] != doc_s[:-1])
+    run_start = jnp.concatenate([jnp.ones(1, bool), changed])
+    run_start = jnp.logical_and(run_start, tok_valid_s)
+    run_idx = jnp.cumsum(run_start.astype(jnp.int32)) - 1  # run id per token
+    n_pairs = run_idx[-1] + 1
+    # All tokens of a run share doc/term, so duplicate scatters write the
+    # same value — order doesn't matter.
+    safe_run = jnp.where(tok_valid_s, run_idx, cap - 1)
+    doc_o = jnp.zeros(cap, doc_ids.dtype).at[safe_run].set(doc_s)
+    term_o = jnp.zeros(cap, term_ids.dtype).at[safe_run].set(term_s)
+    count_o = jax.ops.segment_sum(
+        tok_valid_s.astype(dtype), safe_run, num_segments=cap
+    )
+    valid = (jnp.arange(cap) < n_pairs).astype(dtype)
+    return SparseCounts(
+        doc=doc_o, term=term_o, count=count_o * valid, n_pairs=n_pairs, valid=valid
+    )
+
+
+def document_frequency(counts: SparseCounts, vocab: int) -> jax.Array:
+    """The DF pass: distinct (term, doc) → (term, 1) → reduceByKey(add).
+    Each valid COO row *is* one distinct pair, so DF is a segment_sum of the
+    validity mask over terms."""
+    return jax.ops.segment_sum(counts.valid, counts.term, num_segments=vocab)
+
+
+def idf_vector(df: jax.Array, n_docs: jax.Array | float, mode: IdfMode) -> jax.Array:
+    """IDF formula variants (SURVEY.md §4 — the reference's exact smoothing
+    is unverifiable, so every common variant is pinned behind the flag).
+    Terms with df == 0 get idf 0 (they never appear, weight is 0 anyway) —
+    avoids inf under CLASSIC."""
+    n = jnp.asarray(n_docs, df.dtype)
+    safe_df = jnp.maximum(df, 1.0)
+    if mode is IdfMode.CLASSIC:
+        idf = jnp.log(n / safe_df)
+    elif mode is IdfMode.MLLIB:
+        idf = jnp.log((n + 1.0) / (df + 1.0))
+    elif mode is IdfMode.SMOOTH:
+        idf = jnp.log((1.0 + n) / (1.0 + df)) + 1.0
+    else:
+        raise ValueError(f"unknown idf mode {mode}")
+    return jnp.where(df > 0, idf, 0.0)
+
+
+def tf_values(
+    counts: SparseCounts, doc_lengths: jax.Array, mode: TfMode
+) -> jax.Array:
+    """TF variants over the raw per-pair counts."""
+    if mode is TfMode.RAW:
+        return counts.count
+    if mode is TfMode.FREQ:
+        dl = jnp.maximum(doc_lengths[counts.doc].astype(counts.count.dtype), 1.0)
+        return counts.count / dl
+    if mode is TfMode.LOGNORM:
+        return jnp.where(counts.count > 0, 1.0 + jnp.log(counts.count), 0.0) * counts.valid
+    raise ValueError(f"unknown tf mode {mode}")
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_docs", "vocab", "tf_mode", "idf_mode", "l2_normalize"),
+)
+def tfidf_pipeline(
+    doc_ids: jax.Array,
+    term_ids: jax.Array,
+    doc_lengths: jax.Array,
+    *,
+    n_docs: int,
+    vocab: int,
+    tf_mode: TfMode = TfMode.RAW,
+    idf_mode: IdfMode = IdfMode.CLASSIC,
+    l2_normalize: bool = False,
+) -> TfidfResult:
+    """The full batch pipeline as one XLA program: TF pass → DF pass → IDF
+    vector → weight join (→ optional per-doc L2 norm, sklearn-style)."""
+    counts = count_pairs(doc_ids, term_ids)
+    df = document_frequency(counts, vocab)
+    idf = idf_vector(df, float(n_docs), idf_mode)
+    tf = tf_values(counts, doc_lengths, tf_mode)
+    w = tf * idf[counts.term] * counts.valid
+    if l2_normalize:
+        sq = jax.ops.segment_sum(w * w, counts.doc, num_segments=n_docs)
+        norm = jnp.sqrt(jnp.maximum(sq, 1e-30))
+        w = w / norm[counts.doc]
+    return TfidfResult(
+        doc=counts.doc, term=counts.term, weight=w,
+        n_pairs=counts.n_pairs, valid=counts.valid, df=df, idf=idf,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("vocab",))
+def chunk_counts(
+    doc_ids: jax.Array,
+    term_ids: jax.Array,
+    token_valid: jax.Array,
+    *,
+    vocab: int,
+) -> tuple[SparseCounts, jax.Array]:
+    """Streaming-ingest kernel: one fixed-shape chunk → (per-pair counts,
+    per-term DF increment).  Compiles once for the chunk shape; every chunk
+    reuses the executable (SURVEY.md §5.7)."""
+    counts = count_pairs(doc_ids, term_ids, token_valid=token_valid)
+    df = document_frequency(counts, vocab)
+    return counts, df
+
+
+@functools.partial(jax.jit, static_argnames=("n_docs", "k"))
+def score_query(
+    result: TfidfResult,
+    query_weights: jax.Array,  # f[vocab] — query's weight per term
+    *,
+    n_docs: int,
+    k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """A11 top-k query scoring: score(doc) = Σ_t w[doc,t]·q[t], then top-k.
+    The sparse dot rides the same segment_sum machinery as everything else."""
+    per_pair = result.weight * query_weights[result.term] * result.valid
+    scores = jax.ops.segment_sum(per_pair, result.doc, num_segments=n_docs)
+    return jax.lax.top_k(scores, k)

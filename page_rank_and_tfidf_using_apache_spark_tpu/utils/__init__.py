@@ -1,0 +1,25 @@
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    DanglingMode,
+    IdfMode,
+    PageRankConfig,
+    RankInit,
+    TfMode,
+    TfidfConfig,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import (
+    MetricsRecorder,
+    Timer,
+    logger,
+)
+
+__all__ = [
+    "DanglingMode",
+    "IdfMode",
+    "PageRankConfig",
+    "RankInit",
+    "TfMode",
+    "TfidfConfig",
+    "MetricsRecorder",
+    "Timer",
+    "logger",
+]

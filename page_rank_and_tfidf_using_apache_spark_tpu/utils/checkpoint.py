@@ -1,0 +1,82 @@
+"""Checkpoint / resume (SURVEY.md §5.3/§5.4).
+
+Reference counterpart: Spark lineage recomputation + ``RDD.checkpoint()``.
+On TPU there is no lineage to replay, so recovery is restart-from-snapshot:
+we save the live state arrays plus a step counter and the config hash, and
+refuse to resume under a different semantic configuration.
+
+Format: flat ``.npz`` (numpy) plus a JSON sidecar — deliberately dependency
+-free and host-readable.  Writes are atomic (tmp file + rename) so a kill
+mid-write never corrupts the latest checkpoint; the fault-injection test in
+``tests/test_checkpoint.py`` exercises exactly that.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any
+
+import numpy as np
+
+
+_META_KEY = "__ckpt_meta__"
+
+
+def save_checkpoint(
+    directory: str,
+    step: int,
+    arrays: dict[str, np.ndarray],
+    config_hash: str,
+    extra: dict[str, Any] | None = None,
+) -> str:
+    """Atomically write ``step``'s state; returns the checkpoint path."""
+    os.makedirs(directory, exist_ok=True)
+    meta = {"step": int(step), "config_hash": config_hash, "extra": extra or {}}
+    path = os.path.join(directory, f"ckpt_{step:08d}.npz")
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(
+                f,
+                **{k: np.asarray(v) for k, v in arrays.items()},
+                **{_META_KEY: np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)},
+            )
+        os.replace(tmp, path)  # atomic on POSIX
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    # "latest" pointer, also atomic.
+    ptr = os.path.join(directory, "LATEST")
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    with os.fdopen(fd, "w") as f:
+        f.write(os.path.basename(path))
+    os.replace(tmp, ptr)
+    return path
+
+
+def latest_checkpoint(directory: str) -> str | None:
+    ptr = os.path.join(directory, "LATEST")
+    if not os.path.exists(ptr):
+        return None
+    with open(ptr) as f:
+        name = f.read().strip()
+    path = os.path.join(directory, name)
+    return path if os.path.exists(path) else None
+
+
+def load_checkpoint(
+    path: str, expect_config_hash: str | None = None
+) -> tuple[int, dict[str, np.ndarray], dict[str, Any]]:
+    """Returns (step, arrays, extra). Raises on config-hash mismatch."""
+    with np.load(path) as z:
+        meta = json.loads(bytes(z[_META_KEY]).decode())
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+    if expect_config_hash is not None and meta["config_hash"] != expect_config_hash:
+        raise ValueError(
+            f"checkpoint {path} was written under config {meta['config_hash']}, "
+            f"but current config is {expect_config_hash}; refusing to resume "
+            "across semantic changes"
+        )
+    return meta["step"], arrays, meta["extra"]

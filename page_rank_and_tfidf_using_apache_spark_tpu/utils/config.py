@@ -1,0 +1,174 @@
+"""Config system: frozen dataclasses ↔ CLI flags.
+
+Reference counterpart: ``SparkConf`` + positional ``spark-submit`` argv
+(SURVEY.md §2.2 R10, §5.6).  Every semantic ambiguity in the reconstructed
+reference behavior (dangling-mass handling, rank init, IDF smoothing — see
+SURVEY.md §3.1/§4) is an explicit flag here, with the Spark-parity value as
+the default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import hashlib
+import json
+from typing import Any
+
+
+class DanglingMode(str, enum.Enum):
+    """What happens to rank mass at nodes with no out-links.
+
+    The canonical Spark example silently drops it (dangling nodes never
+    appear as a ``links`` key, so their mass vanishes each iteration —
+    SURVEY.md §3.1).  ``REDISTRIBUTE`` is the textbook/networkx behavior:
+    dangling mass is spread uniformly over all nodes, keeping ``sum(ranks)``
+    constant.
+    """
+
+    DROP = "drop"
+    REDISTRIBUTE = "redistribute"
+
+
+class RankInit(str, enum.Enum):
+    """Initial rank value. The canonical Spark example uses 1.0 per node
+    (so ranks sum to N); ``UNIFORM`` is 1/N (ranks sum to 1)."""
+
+    ONE = "one"
+    UNIFORM = "uniform"
+
+
+class IdfMode(str, enum.Enum):
+    """IDF formula variant (SURVEY.md §4: the reference's exact smoothing is
+    unverifiable, so all common variants are pinned behind this flag).
+
+    - CLASSIC: ``log(N / df)`` — the textbook formula most course projects use.
+    - MLLIB:   ``log((N + 1) / (df + 1))`` — Spark MLlib's smoothing.
+    - SMOOTH:  ``log((1 + N) / (1 + df)) + 1`` — sklearn's ``smooth_idf``.
+    """
+
+    CLASSIC = "classic"
+    MLLIB = "mllib"
+    SMOOTH = "smooth"
+
+
+class TfMode(str, enum.Enum):
+    """TF variant: RAW counts (Spark canonical), FREQ = count/doc_len,
+    LOGNORM = 1 + log(count)."""
+
+    RAW = "raw"
+    FREQ = "freq"
+    LOGNORM = "lognorm"
+
+
+@dataclasses.dataclass(frozen=True)
+class PageRankConfig:
+    """Configuration for a PageRank run.
+
+    Mirrors the reference CLI shape ``pagerank <edges> <iters>`` plus
+    explicit flags for every reconstructed-semantics choice.
+    """
+
+    iterations: int = 20
+    damping: float = 0.85
+    # Convergence: if tol > 0, stop early when the L1 delta between
+    # successive rank vectors falls below tol (runs inside lax.while_loop).
+    tol: float = 0.0
+    dangling: DanglingMode = DanglingMode.DROP
+    init: RankInit = RankInit.ONE
+    # Exact emulation of the canonical Spark example's shrinking key-set
+    # semantics (nodes absent from the join drop out — SURVEY.md §3.1).
+    # Only meaningful with dangling=DROP, init=ONE.
+    spark_exact: bool = False
+    # Personalized PageRank: restart concentrated on these node ids instead
+    # of uniform (BASELINE.json:10). None => standard PageRank.
+    personalize: tuple[int, ...] | None = None
+    # Sparse matvec implementation: "segment" (sorted segment_sum — default),
+    # "bcoo" (jax.experimental.sparse), or "pallas" (hand-written TPU kernel).
+    spmv_impl: str = "segment"
+    dtype: str = "float32"
+    # Checkpoint every k iterations (0 = off) into checkpoint_dir.
+    checkpoint_every: int = 0
+    checkpoint_dir: str | None = None
+
+    def __post_init__(self) -> None:
+        if self.iterations < 0:
+            raise ValueError(f"iterations must be >= 0, got {self.iterations}")
+        if not 0.0 <= self.damping <= 1.0:
+            raise ValueError(f"damping must be in [0, 1], got {self.damping}")
+        if self.spark_exact and self.dangling is not DanglingMode.DROP:
+            raise ValueError("spark_exact requires dangling=drop")
+        if self.spmv_impl not in ("segment", "bcoo", "pallas"):
+            raise ValueError(f"unknown spmv_impl {self.spmv_impl!r}")
+        # Accept plain strings for enum fields (CLI / JSON round-trips).
+        object.__setattr__(self, "dangling", DanglingMode(self.dangling))
+        object.__setattr__(self, "init", RankInit(self.init))
+        if self.personalize is not None:
+            object.__setattr__(self, "personalize", tuple(int(x) for x in self.personalize))
+
+    def config_hash(self) -> str:
+        """Hash of the *semantic* fields only: run length, tolerance, and
+        checkpoint placement are operational — a checkpoint taken at
+        iteration k is valid for any longer run of the same semantics."""
+        return _hash_config(self, exclude={"iterations", "tol", "checkpoint_every", "checkpoint_dir"})
+
+
+@dataclasses.dataclass(frozen=True)
+class TfidfConfig:
+    """Configuration for a TF-IDF run over a corpus.
+
+    ``vocab_bits`` fixes the hashed vocabulary to ``2**vocab_bits`` ids
+    (BASELINE.json:8 names 2^18 for the 20-Newsgroups config).
+    """
+
+    vocab_bits: int = 18
+    ngram: int = 1  # 1 = unigram, 2 = uni+bigram (BASELINE.json:11)
+    tf_mode: TfMode = TfMode.RAW
+    idf_mode: IdfMode = IdfMode.CLASSIC
+    l2_normalize: bool = False
+    lowercase: bool = True
+    min_token_len: int = 1
+    # Streaming ingest (BASELINE.json:11): docs are fed in fixed-size chunks
+    # of this many tokens; 0 = single batch.
+    chunk_tokens: int = 0
+    checkpoint_every: int = 0  # chunks between checkpoints (0 = off)
+    checkpoint_dir: str | None = None
+    dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        if not 1 <= self.vocab_bits <= 30:
+            raise ValueError(f"vocab_bits must be in [1, 30], got {self.vocab_bits}")
+        if self.ngram not in (1, 2):
+            raise ValueError(f"ngram must be 1 or 2, got {self.ngram}")
+        object.__setattr__(self, "tf_mode", TfMode(self.tf_mode))
+        object.__setattr__(self, "idf_mode", IdfMode(self.idf_mode))
+
+    @property
+    def vocab_size(self) -> int:
+        return 1 << self.vocab_bits
+
+    def config_hash(self) -> str:
+        """Semantic fields only (chunking/checkpoint placement excluded —
+        the accumulated DF/TF state is chunk-boundary-independent)."""
+        return _hash_config(self, exclude={"chunk_tokens", "checkpoint_every", "checkpoint_dir"})
+
+
+def _to_jsonable(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {f.name: _to_jsonable(getattr(obj, f.name)) for f in dataclasses.fields(obj)}
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if isinstance(obj, (list, tuple)):
+        return [_to_jsonable(x) for x in obj]
+    return obj
+
+
+def config_to_json(cfg: Any) -> str:
+    return json.dumps(_to_jsonable(cfg), sort_keys=True)
+
+
+def _hash_config(cfg: Any, exclude: set[str] = frozenset()) -> str:
+    """Stable short hash used to tag checkpoints and metrics as belonging to
+    one semantic configuration (SURVEY.md §5.4)."""
+    d = {k: v for k, v in _to_jsonable(cfg).items() if k not in exclude}
+    return hashlib.sha256(json.dumps(d, sort_keys=True).encode()).hexdigest()[:16]

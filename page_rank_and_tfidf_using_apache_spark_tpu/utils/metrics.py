@@ -1,0 +1,63 @@
+"""Structured metrics / logging (SURVEY.md §5.5).
+
+Reference counterpart: the Spark UI stage/task counters and log4j lines.
+Here every iteration emits one structured record
+(``iter, l1_delta, dangling_mass, secs``), collected in-memory and dumpable
+as JSON for the bench harness that feeds BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import sys
+import time
+from typing import Any, Iterator
+
+logger = logging.getLogger("pr_tfidf_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s"))
+    logger.addHandler(_h)
+    logger.setLevel(logging.INFO)
+
+
+@dataclasses.dataclass
+class MetricsRecorder:
+    """Collects per-step structured records and run-level scalars."""
+
+    records: list[dict[str, Any]] = dataclasses.field(default_factory=list)
+    scalars: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def record(self, **kwargs: Any) -> None:
+        self.records.append(kwargs)
+        logger.info("%s", json.dumps(kwargs, default=float))
+
+    def scalar(self, name: str, value: Any) -> None:
+        self.scalars[name] = value
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"records": self.records, "scalars": self.scalars}, default=float
+        )
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+
+class Timer:
+    """Wall-clock timer context; remember to block_until_ready() the device
+    values inside the block — XLA dispatch is async."""
+
+    def __enter__(self) -> "Timer":
+        self.start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.elapsed = time.perf_counter() - self.start
+
+
+def timed() -> Iterator[Timer]:  # pragma: no cover - convenience alias
+    return Timer()

@@ -1,0 +1,34 @@
+"""Tracing / profiling (SURVEY.md §5.1).
+
+Reference counterpart: the Spark web UI + event log.  Here the equivalent is
+an XLA device trace: ``trace(logdir)`` wraps a region in
+``jax.profiler.trace`` producing a TensorBoard-compatible profile of every
+compiled program and collective, and ``annotate(name)`` marks host-side
+phases so ingest vs compute shows up in the timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def trace(logdir: str | None) -> Iterator[None]:
+    """Profile the enclosed region into ``logdir`` (no-op if None)."""
+    if logdir is None:
+        yield
+        return
+    import jax.profiler
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named host-side phase, visible in the profiler timeline."""
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

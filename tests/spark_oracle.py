@@ -1,0 +1,49 @@
+"""Pure-python oracle reproducing the canonical Spark example's semantics.
+
+The reference's PageRank is fingerprinted by BASELINE.json:5 as the
+``links.join(ranks).flatMap(computeContribs).reduceByKey(add)`` chain — the
+Spark distribution's own example program.  pyspark is not installed here
+(SURVEY.md §6), so this module simulates those exact RDD semantics with
+dicts: ``distinct().groupByKey()`` adjacency, inner-join contribution
+emission, and the shrinking rank key-set (nodes that receive no
+contribution drop out of the rank table — SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+
+def spark_pagerank(
+    edges: list[tuple[int, int]], iterations: int, damping: float = 0.85
+) -> dict[int, float]:
+    """Ranks keyed exactly like the canonical example's final RDD: only
+    nodes present after the last ``reduceByKey`` appear."""
+    links: dict[int, list[int]] = defaultdict(list)
+    for a, b in sorted(set(edges)):  # .distinct().groupByKey()
+        links[a].append(b)
+    ranks = {k: 1.0 for k in links}  # links.mapValues(lambda _: 1.0)
+    for _ in range(iterations):
+        contribs: dict[int, float] = defaultdict(float)
+        for src, nbrs in links.items():
+            if src in ranks:  # inner join
+                c = ranks[src] / len(nbrs)
+                for d in nbrs:  # flatMap(computeContribs)
+                    contribs[d] += c  # reduceByKey(add)
+        ranks = {k: (1.0 - damping) + damping * v for k, v in contribs.items()}
+    return dict(ranks)
+
+
+def spark_tfidf_counts(
+    docs: list[list[str]],
+) -> tuple[dict[tuple[str, int], int], dict[str, int]]:
+    """The reference's two reduceByKey passes over ((term, doc), 1) records:
+    returns (term-frequency counts, document frequencies)."""
+    tf: dict[tuple[str, int], int] = defaultdict(int)
+    for d, tokens in enumerate(docs):
+        for t in tokens:
+            tf[(t, d)] += 1
+    df: dict[str, int] = defaultdict(int)
+    for (t, _d) in tf:  # distinct (term, doc) → (term, 1) → reduceByKey
+        df[t] += 1
+    return dict(tf), dict(df)

@@ -1,0 +1,76 @@
+"""Checkpoint/resume + fault injection (SURVEY.md §5.3/§5.4): recovery on
+TPU is restart-from-snapshot; these tests kill runs mid-flight and assert
+bit-equal results after resume."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu import PageRankConfig, TfidfConfig, pagerank
+from page_rank_and_tfidf_using_apache_spark_tpu.io import synthetic_powerlaw
+from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import run_pagerank
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf_streaming
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
+
+
+def test_pagerank_checkpoint_resume_identical(tmp_path):
+    g = synthetic_powerlaw(100, 400, seed=11)
+    base_cfg = dict(iterations=12, dangling="redistribute", init="uniform", dtype="float64")
+    full = pagerank(g, PageRankConfig(**base_cfg))
+
+    # run with checkpoints, "crash" by only running the first 8 iterations
+    ckdir = str(tmp_path / "ck")
+    partial_cfg = PageRankConfig(**{**base_cfg, "iterations": 8},
+                                 checkpoint_every=4, checkpoint_dir=ckdir)
+    run_pagerank(g, partial_cfg)
+    assert ckpt.latest_checkpoint(ckdir) is not None
+
+    # resume under the full config and finish
+    resume_cfg = PageRankConfig(**base_cfg, checkpoint_every=4, checkpoint_dir=ckdir)
+    res = run_pagerank(g, resume_cfg, resume=True)
+    np.testing.assert_array_equal(res.ranks, full.ranks)
+
+
+def test_checkpoint_config_hash_guard(tmp_path):
+    g = synthetic_powerlaw(50, 150, seed=2)
+    ckdir = str(tmp_path / "ck")
+    cfg = PageRankConfig(iterations=8, checkpoint_every=2, checkpoint_dir=ckdir,
+                         dangling="redistribute", init="uniform")
+    run_pagerank(g, cfg)
+    other = PageRankConfig(iterations=8, damping=0.5, checkpoint_every=2,
+                           checkpoint_dir=ckdir, dangling="redistribute", init="uniform")
+    with pytest.raises(ValueError, match="refusing to resume"):
+        run_pagerank(g, other, resume=True)
+
+
+def test_atomic_write_survives_partial_tmp(tmp_path):
+    """A leftover .tmp file (simulated kill mid-write) must not corrupt the
+    LATEST pointer or the resumable state."""
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, 3, {"x": np.arange(4)}, "h")
+    with open(os.path.join(d, "junk.tmp"), "wb") as f:
+        f.write(b"\x00garbage")  # simulated torn write
+    latest = ckpt.latest_checkpoint(d)
+    step, arrays, _ = ckpt.load_checkpoint(latest, "h")
+    assert step == 3
+    np.testing.assert_array_equal(arrays["x"], np.arange(4))
+
+
+def test_tfidf_streaming_resume(tmp_path):
+    docs = [f"tok{i} tok{i % 3} shared word" for i in range(12)]
+    chunks = [docs[i : i + 3] for i in range(0, 12, 3)]
+    cfg = TfidfConfig(vocab_bits=12, checkpoint_every=1,
+                      checkpoint_dir=str(tmp_path / "ck"), l2_normalize=True,
+                      idf_mode="smooth")
+    full = run_tfidf_streaming(chunks, cfg)
+
+    # crash after 2 chunks: feed only the first two, then resume with all
+    cfg2 = TfidfConfig(vocab_bits=12, checkpoint_every=1,
+                       checkpoint_dir=str(tmp_path / "ck2"), l2_normalize=True,
+                       idf_mode="smooth")
+    run_tfidf_streaming(chunks[:2], cfg2)
+    res = run_tfidf_streaming(chunks, cfg2, resume=True)
+    assert res.n_docs == full.n_docs
+    np.testing.assert_allclose(res.to_dense(), full.to_dense(), atol=1e-6)

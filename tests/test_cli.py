@@ -1,0 +1,54 @@
+"""CLI smoke tests — the reference's user surface is the command line
+(SURVEY.md L5), so the drivers get end-to-end coverage."""
+
+import json
+import os
+
+from page_rank_and_tfidf_using_apache_spark_tpu.cli import pagerank as pr_cli
+from page_rank_and_tfidf_using_apache_spark_tpu.cli import tfidf as tfidf_cli
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "tiny.txt")
+
+
+def test_pagerank_cli_file_output(tmp_path, capsys):
+    out = tmp_path / "ranks.txt"
+    rc = pr_cli.main([FIXTURE, "10", "--output", str(out),
+                      "--dangling", "redistribute", "--init", "uniform",
+                      "--dtype", "float64",
+                      "--metrics-json", str(tmp_path / "m.json")])
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    assert len(lines) == 5  # tiny.txt has 5 nodes
+    ranks = [float(l.split("\t")[1]) for l in lines]
+    assert ranks == sorted(ranks, reverse=True)
+    m = json.loads((tmp_path / "m.json").read_text())
+    assert any("l1_delta" in r for r in m["records"])
+
+
+def test_pagerank_cli_synthetic_stdout(capsys):
+    rc = pr_cli.main(["synthetic:50,200,1", "5", "--top-k", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 3
+
+
+def test_tfidf_cli_dir(tmp_path, capsys):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    (d / "a.txt").write_text("apple banana apple")
+    (d / "b.txt").write_text("banana cherry")
+    out = tmp_path / "w.tsv"
+    rc = tfidf_cli.main([str(d), "--vocab-bits", "12", "--output", str(out),
+                         "--query", "apple", "--top-k", "2"])
+    assert rc == 0
+    assert len(out.read_text().splitlines()) == 4  # 4 distinct (term,doc) pairs
+    q = capsys.readouterr().out.strip().splitlines()
+    assert q and q[0].startswith("a.txt")  # apple doc wins the query
+
+
+def test_tfidf_cli_lines_streaming(tmp_path):
+    f = tmp_path / "corpus.txt"
+    f.write_text("dog cat\ncat fish\nfish dog dog\n")
+    rc = tfidf_cli.main([str(f), "--lines", "--streaming", "--chunk-docs", "2",
+                         "--vocab-bits", "12"])
+    assert rc == 0

@@ -1,0 +1,82 @@
+"""Golden-file + unit tests for SNAP ingest (SURVEY.md A2/A3, §4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu.io import (
+    from_edges,
+    load_snap,
+    parse_snap_text,
+    save_ranks,
+    synthetic_powerlaw,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "tiny.txt")
+
+
+def test_parse_snap_fixture():
+    g = load_snap(FIXTURE)
+    # ids 0,1,2,4,5 → compacted to 0..4 (id 3 absent in input)
+    assert g.n_nodes == 5
+    assert list(g.node_ids) == [0, 1, 2, 4, 5]
+    # duplicate edge 1→2 deduped; self-loop 5→5 kept
+    assert g.n_edges == 7
+    # destination-sorted invariant
+    assert (np.diff(g.dst) >= 0).all()
+    # out-degrees on original ids: 0→{1,2,4}, 1→{2}, 2→{0,4}, 4 dangling, 5→{5}
+    assert list(g.out_degree) == [3, 1, 2, 0, 1]
+    assert list(g.dangling_mask) == [False, False, False, True, False]
+
+
+def test_parse_equivalence_text_vs_file():
+    with open(FIXTURE, "rb") as f:
+        g2 = parse_snap_text(f.read())
+    g1 = load_snap(FIXTURE)
+    np.testing.assert_array_equal(g1.src, g2.src)
+    np.testing.assert_array_equal(g1.dst, g2.dst)
+
+
+def test_dedup_and_self_loops():
+    g = from_edges(np.array([1, 1, 2, 2]), np.array([2, 2, 2, 1]))
+    assert g.n_edges == 3  # (1,2) deduped, (2,2) self-loop kept
+    g2 = from_edges(np.array([1, 1, 2, 2]), np.array([2, 2, 2, 1]), drop_self_loops=True)
+    assert g2.n_edges == 2
+
+
+def test_empty_graph():
+    g = parse_snap_text("# only comments\n")
+    assert g.n_nodes == 0 and g.n_edges == 0
+
+
+def test_odd_token_count_raises():
+    with pytest.raises(ValueError, match="odd token count"):
+        parse_snap_text("1 2 3\n")
+
+
+def test_compact_ids_roundtrip():
+    g = from_edges(np.array([100, 7]), np.array([7, 2000]))
+    assert g.n_nodes == 3
+    assert list(g.node_ids) == [7, 100, 2000]
+
+
+def test_save_ranks(tmp_path):
+    g = load_snap(FIXTURE)
+    ranks = np.arange(g.n_nodes, dtype=np.float32)
+    out = tmp_path / "ranks.txt"
+    save_ranks(str(out), g, ranks, top_k=2)
+    lines = out.read_text().splitlines()
+    assert len(lines) == 2
+    # highest rank first, mapped back to original node ids
+    nid, r = lines[0].split("\t")
+    assert int(nid) == g.node_ids[g.n_nodes - 1]
+
+
+def test_synthetic_powerlaw_shape():
+    g = synthetic_powerlaw(1000, 5000, seed=1)
+    assert g.n_nodes <= 1000
+    assert g.n_edges <= 5000  # dedup may shrink
+    # power-law: max in-degree far above mean
+    indeg = np.bincount(g.dst, minlength=g.n_nodes)
+    assert indeg.max() > 10 * indeg.mean()

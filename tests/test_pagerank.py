@@ -1,0 +1,148 @@
+"""PageRank parity tests (SURVEY.md §4): networkx oracle for the textbook
+semantics, the pure-python RDD-semantics oracle for Spark parity, both at
+the L1 ≤ 1e-6 bar BASELINE.json:5 sets (float64 on CPU backend)."""
+
+import numpy as np
+import networkx as nx
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu import PageRankConfig, pagerank
+from page_rank_and_tfidf_using_apache_spark_tpu.io import from_edges, synthetic_powerlaw
+
+from tests.spark_oracle import spark_pagerank
+
+EDGES_SMALL = [(0, 1), (0, 2), (1, 2), (2, 0), (2, 4), (5, 5), (0, 4), (3, 2)]
+
+
+def _graph(edges):
+    a = np.array(edges)
+    return from_edges(a[:, 0], a[:, 1])
+
+
+def _nx_ranks(edges, n, **kw):
+    G = nx.DiGraph()
+    G.add_nodes_from(range(n))
+    G.add_edges_from(edges)
+    d = nx.pagerank(G, alpha=0.85, max_iter=500, tol=1e-14, **kw)
+    return np.array([d[i] for i in range(n)])
+
+
+@pytest.mark.parametrize("edges", [EDGES_SMALL])
+def test_parity_networkx_redistribute(edges):
+    g = _graph(edges)
+    res = pagerank(
+        g, iterations=200, dangling="redistribute", init="uniform", dtype="float64"
+    )
+    expect = _nx_ranks([(int(a), int(b)) for a, b in zip(g.src, g.dst)], g.n_nodes)
+    # graph node order == compacted ids here (ids are 0..5 contiguous)
+    assert np.abs(res.ranks - expect).sum() <= 1e-6
+    assert abs(res.ranks.sum() - 1.0) < 1e-9
+
+
+def test_parity_networkx_synthetic():
+    g = synthetic_powerlaw(300, 1500, seed=3)
+    res = pagerank(
+        g, iterations=300, dangling="redistribute", init="uniform", dtype="float64"
+    )
+    edges = list(zip(g.src.tolist(), g.dst.tolist()))
+    expect = _nx_ranks(edges, g.n_nodes)
+    assert np.abs(res.ranks - expect).sum() <= 1e-6
+
+
+def test_spark_exact_matches_rdd_oracle():
+    g = _graph(EDGES_SMALL)
+    res = pagerank(g, PageRankConfig(iterations=7, spark_exact=True, dtype="float64"))
+    oracle = spark_pagerank(EDGES_SMALL, 7)
+    for i in range(g.n_nodes):
+        nid = int(g.node_ids[i])
+        if nid in oracle:
+            assert res.ranks[i] == pytest.approx(oracle[nid], abs=1e-9), nid
+        else:
+            assert res.ranks[i] == 0.0, nid
+
+
+def test_spark_exact_matches_rdd_oracle_synthetic():
+    g = synthetic_powerlaw(200, 600, seed=5)
+    edges = [(int(g.node_ids[a]), int(g.node_ids[b])) for a, b in zip(g.src, g.dst)]
+    res = pagerank(g, PageRankConfig(iterations=10, spark_exact=True, dtype="float64"))
+    oracle = spark_pagerank(edges, 10)
+    got = {int(g.node_ids[i]): res.ranks[i] for i in range(g.n_nodes) if res.ranks[i] != 0.0}
+    assert set(got) == set(oracle)
+    l1 = sum(abs(got[k] - oracle[k]) for k in oracle)
+    assert l1 <= 1e-6
+
+
+def test_drop_mode_loses_mass():
+    g = _graph(EDGES_SMALL)  # node 4 dangling
+    res = pagerank(g, iterations=50, dangling="drop", init="uniform", dtype="float64")
+    assert res.ranks.sum() < 1.0  # dangling mass vanished, by design
+
+
+def test_personalized_matches_networkx():
+    g = _graph(EDGES_SMALL)
+    src_node = 0
+    res = pagerank(
+        g,
+        iterations=300,
+        dangling="redistribute",
+        init="uniform",
+        personalize=(src_node,),
+        dtype="float64",
+    )
+    edges = [(int(a), int(b)) for a, b in zip(g.src, g.dst)]
+    expect = _nx_ranks(
+        edges, g.n_nodes, personalization={i: float(i == src_node) for i in range(g.n_nodes)}
+    )
+    assert np.abs(res.ranks - expect).sum() <= 1e-6
+
+
+def test_tolerance_early_stop():
+    g = _graph(EDGES_SMALL)
+    res = pagerank(
+        g, iterations=500, tol=1e-10, dangling="redistribute", init="uniform", dtype="float64"
+    )
+    assert res.iterations < 500
+    assert res.l1_delta <= 1e-10
+
+
+def test_bcoo_impl_matches_segment():
+    g = synthetic_powerlaw(100, 400, seed=7)
+    r1 = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
+                  spmv_impl="segment", dtype="float64")
+    r2 = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
+                  spmv_impl="bcoo", dtype="float64")
+    assert np.abs(r1.ranks - r2.ranks).max() < 1e-12
+
+
+def test_spark_default_config_shape():
+    """Reference defaults: 20 iters, d=0.85, init ONE, drop (BASELINE.json:7)."""
+    cfg = PageRankConfig()
+    assert cfg.iterations == 20 and cfg.damping == 0.85
+    g = _graph(EDGES_SMALL)
+    res = pagerank(g, cfg)
+    assert res.iterations == 20
+    assert res.ranks.shape == (g.n_nodes,)
+
+
+def test_personalize_duplicate_ids_mass():
+    """Duplicate restart ids must accumulate, not overwrite: e sums to 1."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops.pagerank import restart_vector
+
+    cfg = PageRankConfig(personalize=(3, 3, 5), dtype="float64")
+    e = restart_vector(10, cfg)
+    assert e.sum() == 1.0
+    assert e[3] == 2 / 3 and e[5] == 1 / 3
+
+
+def test_from_edges_large_noncompact_ids():
+    """Dedup must be overflow-safe for big raw ids under compact_ids=False."""
+    big = 2**30
+    g = from_edges(np.array([big - 2, big - 2]), np.array([big - 1, big - 1]),
+                   compact_ids=True)
+    assert g.n_edges == 1  # duplicate removed
+
+
+def test_zero_iterations():
+    g = _graph(EDGES_SMALL)
+    res = pagerank(g, iterations=0)
+    np.testing.assert_allclose(res.ranks, 1.0)

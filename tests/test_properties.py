@@ -1,0 +1,61 @@
+"""Property-based tests (SURVEY.md §4): rank-mass conservation, node-relabel
+invariance, hashed-vocab ≈ exact-vocab convergence."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from page_rank_and_tfidf_using_apache_spark_tpu import pagerank, tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.io import from_edges
+from page_rank_and_tfidf_using_apache_spark_tpu.io.text import fnv1a_64, hash_to_vocab
+
+
+edges_strategy = st.lists(
+    st.tuples(st.integers(0, 15), st.integers(0, 15)), min_size=1, max_size=60
+)
+
+
+@settings(max_examples=20, deadline=None)
+@given(edges_strategy)
+def test_rank_mass_conserved(edges):
+    a = np.array(edges)
+    g = from_edges(a[:, 0], a[:, 1])
+    res = pagerank(g, iterations=25, dangling="redistribute", init="uniform",
+                   dtype="float64")
+    assert abs(res.ranks.sum() - 1.0) < 1e-9
+    assert (res.ranks >= 0).all()
+
+
+@settings(max_examples=15, deadline=None)
+@given(edges_strategy, st.integers(0, 1000))
+def test_relabel_invariance(edges, offset):
+    """Adding a constant to every node id must not change the ranks (ids are
+    opaque keys in the reference's RDDs)."""
+    a = np.array(edges)
+    g1 = from_edges(a[:, 0], a[:, 1])
+    g2 = from_edges(a[:, 0] + offset, a[:, 1] + offset)
+    r1 = pagerank(g1, iterations=20, dangling="redistribute", init="uniform",
+                  dtype="float64")
+    r2 = pagerank(g2, iterations=20, dangling="redistribute", init="uniform",
+                  dtype="float64")
+    np.testing.assert_allclose(r1.ranks, r2.ranks, atol=1e-12)
+
+
+@settings(max_examples=10, deadline=None)
+@given(st.lists(st.text(alphabet="abcdefgh", min_size=1, max_size=6),
+                min_size=1, max_size=30))
+def test_hashed_vocab_converges_to_exact(tokens):
+    """With a wide enough hash, hashed TF-IDF == exact-vocab TF-IDF: weights
+    keyed by token hash must match a collision-free computation
+    (SURVEY.md §4 'hashed-vocab ≈ exact-vocab as hash width → large')."""
+    doc = " ".join(tokens)
+    out = tfidf([doc], vocab_bits=22, idf_mode="smooth")
+    uniq = sorted(set(tokens))
+    hids = hash_to_vocab(fnv1a_64(uniq), 22)
+    if len(set(hids.tolist())) != len(uniq):
+        return  # collision at 2^22 is astronomically unlikely; skip if so
+    # every unique token appears with weight idf*(count); smooth idf with
+    # N=1, df=1 gives idf=1, so weight == count
+    counts = {t: tokens.count(t) for t in uniq}
+    dense = out.to_dense()
+    for t, h in zip(uniq, hids):
+        assert dense[0, int(h)] == counts[t]

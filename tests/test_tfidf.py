@@ -1,0 +1,142 @@
+"""TF-IDF parity tests (SURVEY.md §4): sklearn TfidfVectorizer oracle for
+the smooth/l2 variant, the RDD-semantics oracle for the raw count passes,
+manual formula checks for the classic/mllib variants."""
+
+import math
+
+import numpy as np
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu import TfidfConfig, tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
+    add_ngrams,
+    fnv1a_64,
+    hash_to_vocab,
+    tokenize,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf_streaming
+from page_rank_and_tfidf_using_apache_spark_tpu.ops.tfidf import score_query, tfidf_pipeline
+
+from tests.spark_oracle import spark_tfidf_counts
+
+DOCS = [
+    "the cat sat on the mat",
+    "the dog sat",
+    "cats and dogs are friends",
+    "mat mat mat dog",
+    "",  # empty doc must not break anything
+]
+
+
+def _dense(out):
+    return out.to_dense()
+
+
+def test_counts_match_rdd_oracle():
+    out = tfidf(DOCS, vocab_bits=12, idf_mode="classic", tf_mode="raw")
+    toks = [tokenize(d) for d in DOCS]
+    tf_oracle, df_oracle = spark_tfidf_counts(toks)
+    # recover our per-(term, doc) raw counts through the token hash
+    got = {}
+    for d, t, w in zip(out.doc, out.term, out.weight):
+        got[(int(t), int(d))] = w
+    n = len(DOCS)
+    for (term, d), cnt in tf_oracle.items():
+        h = int(hash_to_vocab(fnv1a_64([term]), 12)[0])
+        idf = math.log(n / df_oracle[term])
+        assert got[(h, d)] == pytest.approx(cnt * idf, rel=1e-6), (term, d)
+    # df parity
+    for term, df in df_oracle.items():
+        h = int(hash_to_vocab(fnv1a_64([term]), 12)[0])
+        assert out.df[h] == df
+
+
+def test_parity_sklearn():
+    from sklearn.feature_extraction.text import TfidfVectorizer
+
+    out = tfidf(DOCS, vocab_bits=12, idf_mode="smooth", l2_normalize=True)
+    vec = TfidfVectorizer(token_pattern=r"[A-Za-z0-9]+", norm="l2", smooth_idf=True)
+    X = vec.fit_transform([d for d in DOCS]).toarray()
+    terms = list(vec.get_feature_names_out())
+    hids = hash_to_vocab(fnv1a_64(terms), 12)
+    assert len(set(hids.tolist())) == len(terms), "fixture must be collision-free"
+    ours = _dense(out)[: X.shape[0], hids]
+    np.testing.assert_allclose(ours, X, atol=1e-5)
+
+
+def test_idf_variants():
+    out_c = tfidf(DOCS, vocab_bits=12, idf_mode="classic")
+    out_m = tfidf(DOCS, vocab_bits=12, idf_mode="mllib")
+    n = len(DOCS)
+    h = int(hash_to_vocab(fnv1a_64(["dog"]), 12)[0])
+    df = out_c.df[h]
+    assert df == 2  # "dog" in docs 1 and 3
+    assert out_c.idf[h] == pytest.approx(math.log(n / df), rel=1e-6)
+    assert out_m.idf[h] == pytest.approx(math.log((n + 1) / (df + 1)), rel=1e-6)
+
+
+def test_tf_modes():
+    out_raw = tfidf(["a a a b"], vocab_bits=12, tf_mode="raw", idf_mode="mllib")
+    out_freq = tfidf(["a a a b"], vocab_bits=12, tf_mode="freq", idf_mode="mllib")
+    out_log = tfidf(["a a a b"], vocab_bits=12, tf_mode="lognorm", idf_mode="mllib")
+    ha = int(hash_to_vocab(fnv1a_64(["a"]), 12)[0])
+    idf = math.log(2 / 2)  # mllib with N=1, df=1
+    d_raw, d_freq, d_log = _dense(out_raw), _dense(out_freq), _dense(out_log)
+    # idf == 0 here makes weights 0; check via df-independent ratios instead
+    assert out_raw.df[ha] == 1
+    cfgs = dict(vocab_bits=12, tf_mode="raw", idf_mode="smooth")
+    d_raw = _dense(tfidf(["a a a b"], **cfgs))
+    d_freq = _dense(tfidf(["a a a b"], **{**cfgs, "tf_mode": "freq"}))
+    d_log = _dense(tfidf(["a a a b"], **{**cfgs, "tf_mode": "lognorm"}))
+    assert d_freq[0, ha] == pytest.approx(d_raw[0, ha] / 4)  # count/doclen
+    assert d_log[0, ha] == pytest.approx(d_raw[0, ha] / 3 * (1 + math.log(3)))
+
+
+def test_bigrams():
+    out = tfidf(["red fox jumps"], vocab_bits=14, ngram=2)
+    toks = add_ngrams(tokenize("red fox jumps"), 2)
+    assert "red fox" in toks and "fox jumps" in toks
+    hb = int(hash_to_vocab(fnv1a_64(["red fox"]), 14)[0])
+    assert out.df[hb] == 1
+
+
+def test_streaming_equals_batch():
+    cfg = TfidfConfig(vocab_bits=12, idf_mode="smooth", l2_normalize=True)
+    batch = tfidf(DOCS, cfg)
+    stream = run_tfidf_streaming([DOCS[:2], DOCS[2:4], DOCS[4:]], cfg)
+    np.testing.assert_allclose(_dense(stream), _dense(batch), atol=1e-6)
+    np.testing.assert_array_equal(stream.df, batch.df)
+
+
+def test_streaming_chunk_cap_bump():
+    cfg = TfidfConfig(vocab_bits=12, chunk_tokens=4)
+    stream = run_tfidf_streaming([["a b c d e f g h i j"]], cfg)
+    assert stream.n_docs == 1
+    batch = tfidf(["a b c d e f g h i j"], vocab_bits=12)
+    np.testing.assert_allclose(_dense(stream), _dense(batch), atol=1e-6)
+
+
+def test_score_query_topk():
+    import jax.numpy as jnp
+
+    docs = ["apple banana", "apple apple apple", "cherry", "banana cherry"]
+    cfg = TfidfConfig(vocab_bits=12, idf_mode="smooth", l2_normalize=True)
+    corpus_toks = None
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.text import tokenize_corpus
+
+    corpus = tokenize_corpus(docs, vocab_bits=12)
+    res = tfidf_pipeline(
+        jnp.asarray(corpus.doc_ids), jnp.asarray(corpus.term_ids),
+        jnp.asarray(corpus.doc_lengths),
+        n_docs=4, vocab=1 << 12, idf_mode=cfg.idf_mode, l2_normalize=True,
+    )
+    q = np.zeros(1 << 12, np.float32)
+    q[int(hash_to_vocab(fnv1a_64(["apple"]), 12)[0])] = 1.0
+    scores, idx = score_query(res, jnp.asarray(q), n_docs=4, k=2)
+    assert int(idx[0]) == 1  # "apple apple apple" wins
+    assert scores[0] > scores[1] > 0
+
+
+def test_empty_corpus():
+    out = tfidf([], vocab_bits=10)
+    assert out.n_docs == 0 and out.nnz == 0
