@@ -95,7 +95,7 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
 
             result = pagerank_sharded.run_pagerank_sharded(
-                graph, cfg, n_devices=args.mesh, metrics=metrics
+                graph, cfg, n_devices=args.mesh, metrics=metrics, resume=args.resume
             )
         else:
             result = run_pagerank(graph, cfg, metrics=metrics, resume=args.resume)

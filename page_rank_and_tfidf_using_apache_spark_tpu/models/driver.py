@@ -1,0 +1,100 @@
+"""Shared host-side segment driver for the PageRank runners.
+
+Both the single-chip (models/pagerank.py) and sharded
+(parallel/pagerank_sharded.py) paths execute the same host loop: run the
+compiled iteration program in segments, snapshot state between segments,
+stop early on tolerance.  The loop lives here once so checkpoint/convergence
+fixes cannot diverge between the two drivers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
+
+
+def resume_from_checkpoint(
+    cfg: PageRankConfig, metrics: MetricsRecorder, ranks_np: np.ndarray
+) -> int:
+    """Load the latest checkpoint into ``ranks_np`` (in place, first
+    ``len(arrays['ranks'])`` rows); returns the start iteration."""
+    if not cfg.checkpoint_dir:
+        raise ValueError("resume=True requires checkpoint_dir")
+    latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+    if latest is None:
+        return 0
+    start_iter, arrays, _ = ckpt.load_checkpoint(latest, cfg.config_hash())
+    saved = arrays["ranks"]
+    ranks_np[: saved.shape[0]] = saved
+    metrics.record(event="resume", path=latest, start_iter=start_iter)
+    return start_iter
+
+
+def run_segments(
+    cfg: PageRankConfig,
+    metrics: MetricsRecorder,
+    ranks_dev,
+    start_iter: int,
+    *,
+    make_runner: Callable[[PageRankConfig], Callable],
+    invoke: Callable,
+    extract_np: Callable[[object], np.ndarray],
+    segments_allowed: bool = True,
+    extra_metrics: dict | None = None,
+):
+    """Run ``cfg.iterations`` in checkpoint-sized compiled segments.
+
+    - ``make_runner(seg_cfg)`` compiles the loop for one segment length;
+      called at most twice (body segments + tail) thanks to caching here.
+    - ``invoke(runner, ranks_dev)`` executes and returns
+      ``(ranks_dev, iters_done, delta)`` with a completed host sync.
+    - ``extract_np(ranks_dev)`` yields the checkpointable rank array.
+
+    Returns ``(ranks_dev, done, last_delta)``.
+    """
+    segment = (
+        cfg.checkpoint_every
+        if (cfg.checkpoint_every > 0 and cfg.tol == 0.0 and segments_allowed)
+        else cfg.iterations - start_iter
+    )
+    runners: dict[int, Callable] = {}
+    done = start_iter
+    last_delta = float("inf")
+    while done < cfg.iterations:
+        todo = min(segment, cfg.iterations - done)
+        if todo not in runners:
+            seg_cfg = dataclasses.replace(
+                cfg, iterations=todo, checkpoint_every=0, checkpoint_dir=None
+            )
+            runners[todo] = make_runner(seg_cfg)
+        with Timer() as t:
+            ranks_dev, iters, delta = invoke(runners[todo], ranks_dev)
+        done += int(iters)
+        last_delta = float(delta)
+        metrics.record(
+            iter=done,
+            l1_delta=last_delta,
+            secs=t.elapsed,
+            iters_per_sec=int(iters) / t.elapsed if t.elapsed > 0 else float("inf"),
+            **(extra_metrics or {}),
+        )
+        if cfg.checkpoint_every > 0 and cfg.checkpoint_dir and done < cfg.iterations:
+            path = ckpt.save_checkpoint(
+                cfg.checkpoint_dir, done,
+                {"ranks": extract_np(ranks_dev)}, cfg.config_hash(),
+            )
+            metrics.record(event="checkpoint", path=path, iter=done)
+        if cfg.tol > 0.0:
+            # the while_loop runner handled tolerance in-program; one
+            # segment is the whole run
+            break
+
+    metrics.scalar("iterations", done)
+    metrics.scalar("l1_delta", last_delta)
+    return ranks_dev, done, last_delta

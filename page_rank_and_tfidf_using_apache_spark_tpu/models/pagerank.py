@@ -22,10 +22,10 @@ import jax
 import numpy as np
 
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
+from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
-from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,61 +53,23 @@ def run_pagerank(
     dg = ops.put_graph(graph, cfg.dtype)
     e = jax.device_put(ops.restart_vector(n, cfg))
     ranks = np.asarray(ops.init_ranks(n, cfg))
-    start_iter = 0
-
-    if resume:
-        if not cfg.checkpoint_dir:
-            raise ValueError("resume=True requires checkpoint_dir")
-        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
-        if latest is not None:
-            start_iter, arrays, _ = ckpt.load_checkpoint(latest, cfg.config_hash())
-            ranks = arrays["ranks"]
-            metrics.record(event="resume", path=latest, start_iter=start_iter)
-
+    start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks) if resume else 0
     ranks_dev = jax.device_put(ranks.astype(cfg.dtype))
 
     make = ops.make_spark_exact_runner if cfg.spark_exact else ops.make_pagerank_runner
-    remaining = cfg.iterations - start_iter
-    segment = (
-        cfg.checkpoint_every
-        if (cfg.checkpoint_every > 0 and not cfg.spark_exact and cfg.tol == 0.0)
-        else remaining
+
+    def invoke(runner, rd):
+        rd, iters, delta = runner(dg, rd, e)
+        delta = float(delta)  # scalar fetch is the only reliable device sync
+        return rd, iters, delta
+
+    ranks_dev, done, last_delta = driver.run_segments(
+        cfg, metrics, ranks_dev, start_iter,
+        make_runner=lambda seg_cfg: make(n, seg_cfg),
+        invoke=invoke,
+        extract_np=np.asarray,
+        segments_allowed=not cfg.spark_exact,
     )
-
-    done = start_iter
-    last_delta = float("inf")
-    while done < cfg.iterations:
-        todo = min(segment, cfg.iterations - done)
-        seg_cfg = dataclasses.replace(
-            cfg, iterations=todo, checkpoint_every=0, checkpoint_dir=None
-        )
-        runner = make(n, seg_cfg)
-        with Timer() as t:
-            ranks_dev, iters, delta = runner(dg, ranks_dev, e)
-            ranks_dev.block_until_ready()
-        done += int(iters)
-        last_delta = float(delta)
-        metrics.record(
-            iter=done,
-            l1_delta=last_delta,
-            secs=t.elapsed,
-            iters_per_sec=int(iters) / t.elapsed if t.elapsed > 0 else float("inf"),
-        )
-        if cfg.checkpoint_every > 0 and cfg.checkpoint_dir and done < cfg.iterations:
-            path = ckpt.save_checkpoint(
-                cfg.checkpoint_dir,
-                done,
-                {"ranks": np.asarray(ranks_dev)},
-                cfg.config_hash(),
-            )
-            metrics.record(event="checkpoint", path=path, iter=done)
-        if cfg.tol > 0.0 and last_delta <= cfg.tol:
-            break
-        if todo == remaining and cfg.tol > 0.0:
-            break  # while_loop runner already handled tol internally
-
-    metrics.scalar("iterations", done)
-    metrics.scalar("l1_delta", last_delta)
     return PageRankResult(
         ranks=np.asarray(ranks_dev), iterations=done, l1_delta=last_delta, metrics=metrics
     )

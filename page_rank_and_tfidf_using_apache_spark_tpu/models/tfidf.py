@@ -104,6 +104,24 @@ def run_tfidf(
     )
 
 
+def grow_chunk_cap(
+    need: int, cap: int, metrics: MetricsRecorder, **context
+) -> tuple[int, bool]:
+    """Fixed-shape chunk capacity policy, shared by the streaming and
+    sharded ingest paths: power-of-two start, doubling bumps (each bump is a
+    logged recompile — SURVEY.md §7 'fixed shapes under jit').
+    Returns (cap, changed)."""
+    changed = False
+    if cap <= 0:
+        cap = 1 << max(10, int(np.ceil(np.log2(max(need, 1)))))
+        changed = True
+    while need > cap:
+        cap *= 2
+        changed = True
+        metrics.record(event="chunk_cap_bump", cap=cap, **context)
+    return cap, changed
+
+
 def _pad_chunk(
     corpus: tio.TokenizedCorpus, cap: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -166,11 +184,7 @@ def run_tfidf_streaming(
             min_token_len=cfg.min_token_len,
             doc_id_offset=n_docs,
         )
-        if cap <= 0:
-            cap = 1 << max(10, int(np.ceil(np.log2(max(corpus.n_tokens, 1)))))
-        while corpus.n_tokens > cap:
-            cap *= 2
-            metrics.record(event="chunk_cap_bump", cap=cap, chunk=i)
+        cap, _ = grow_chunk_cap(corpus.n_tokens, cap, metrics, chunk=i)
         doc_ids, term_ids, valid = _pad_chunk(corpus, cap)
         with Timer() as t:
             counts, df_inc = ops.chunk_counts(

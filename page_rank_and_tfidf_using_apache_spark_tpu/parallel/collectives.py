@@ -1,0 +1,53 @@
+"""Thin named wrappers over the XLA collectives this framework uses.
+
+Reference counterpart (SURVEY.md §5.8): the sort-based shuffle + netty
+transport + torrent broadcast stack under every ``reduceByKey``/``join``.
+The rebuild's entire communication vocabulary is four collectives, all
+compiled into the iteration program by XLA and scheduled on ICI/DCN:
+
+- ``psum``          cross-chip combine (the shuffle-reduce; BASELINE.json:5
+                    "allreduced over ICI via lax.psum")
+- ``all_gather``    reassemble a sharded vector (the map-side fetch)
+- ``reduce_scatter`` combine + re-shard in one step (psum that keeps only
+                    your block — halves the bytes when output stays sharded)
+- ``ppermute_ring`` neighbor exchange (the edge-cut / block-rotation
+                    primitive for 2-D shardings, SURVEY.md §2.3)
+
+Kept as a module so the communication surface is explicit, greppable, and
+mockable in tests — not because the wrappers add logic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x: jax.Array, axis: str) -> jax.Array:
+    return lax.psum(x, axis)
+
+
+def all_gather(x: jax.Array, axis: str) -> jax.Array:
+    """Tiled gather: [B] per device → [D*B] on every device."""
+    return lax.all_gather(x, axis, tiled=True)
+
+
+def reduce_scatter(x: jax.Array, axis: str) -> jax.Array:
+    """[D*B] per device → summed, then each device keeps its [B] block."""
+    return lax.psum_scatter(x, axis, tiled=True)
+
+
+def ppermute_ring(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
+    """Rotate block ``x`` ``shift`` steps around the mesh ring."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str) -> jax.Array:
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return lax.axis_size(axis)

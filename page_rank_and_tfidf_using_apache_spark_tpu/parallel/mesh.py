@@ -1,0 +1,77 @@
+"""Device mesh construction + multi-host initialization.
+
+Reference counterpart (SURVEY.md §2.2 R7/R8, §5.8): Spark's executor pool
+and netty transport.  Here the communication substrate is the TPU fabric:
+one ``jax.sharding.Mesh`` whose collectives ride **ICI** within a pod slice
+and **DCN** across hosts — the same collective code serves both, which is
+the whole point of replacing the reference's shuffle with XLA collectives.
+
+Only one physical chip exists in this build environment, so multi-chip
+paths are validated on XLA's simulated host devices
+(``--xla_force_host_platform_device_count``, SURVEY.md §4); the mesh code is
+shape-generic and does not care which backend provides the devices.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+NODES_AXIS = "nodes"  # rank-vector / node-block axis (model-parallel SpMV)
+DATA_AXIS = "data"  # document/chunk axis (data-parallel TF-IDF ingest)
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    axis: str = NODES_AXIS,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """1-D mesh over the first ``n_devices`` devices (all by default).
+
+    Both algorithms in scope shard along a single axis (SURVEY.md §2.3: DP
+    over edges/docs plus 1-D TP of the rank vector), so a 1-D mesh is the
+    native shape; a 2-D (dcn, ici) refinement would slot in here for
+    multi-host runs without touching callers.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), (axis,))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def sharded_along(mesh: Mesh, axis: str) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host init hook (SURVEY.md §5.8): call once per host before any
+    device op; afterwards ``jax.devices()`` spans the whole DCN-connected
+    slice and ``make_mesh`` + the sharded runners work unchanged.
+
+    Untestable with a single host (SURVEY.md §7 'kept thin'): delegates
+    straight to ``jax.distributed.initialize``, which reads cluster env vars
+    when args are None.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

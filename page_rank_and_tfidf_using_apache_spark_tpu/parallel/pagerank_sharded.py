@@ -1,0 +1,304 @@
+"""Multi-chip PageRank: sharded CSR SpMV with XLA collectives.
+
+Reference counterpart (SURVEY.md §2.2 R1/R2, BASELINE.json:9): Spark's
+hash-partitioned RDDs and the shuffle that re-co-partitions
+``links.join(ranks)`` every iteration.  Here the graph is partitioned
+**once** on host, laid out per device, and every iteration's cross-chip
+combine is a single XLA collective over ICI — no repartitioning ever
+happens because the partition is static and the collective does the moving.
+
+Two sharding strategies (SURVEY.md §7 "power-law load imbalance" is why
+both exist):
+
+- ``edges`` (default): each device owns an equal *contiguous slice of the
+  dst-sorted edge array* — perfectly balanced FLOPs even on power-law
+  graphs (a celebrity node's in-edges simply span devices).  The rank
+  vector is replicated; each device segment-sums its slice into a full-size
+  partial and one ``psum`` combines partials (the `reduceByKey`).
+  Dangling mass needs no collective (replicated state).
+- ``nodes``: each device owns a *block of nodes* (rank shard + that block's
+  in-edges) — memory scales 1/D, the layout for graphs whose node state
+  outgrows one chip's HBM (soc-LiveJournal1 config, BASELINE.json:9).
+  Per iteration: ``all_gather`` the degree-weighted rank blocks, local
+  segment_sum into the block, ``psum`` only for the dangling-mass scalar.
+
+Both run the whole iteration loop inside one ``jit`` + ``shard_map``
+program: collectives are compiled into the loop body, so there are zero
+host round-trips between iterations, same as the single-chip path.
+
+``spark_exact`` mode is single-chip-only (it exists for parity testing, not
+scale) — requesting it sharded raises.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
+from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
+from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import PageRankResult
+from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.parallel import collectives as coll
+from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
+    NODES_AXIS,
+    make_mesh,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    DanglingMode,
+    PageRankConfig,
+    RankInit,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
+
+
+class ShardedGraph(NamedTuple):
+    """Host-side partitioned graph layout, ready for device_put.
+
+    ``src`` is always global node ids; ``dst`` is block-local under the
+    ``nodes`` strategy and global under ``edges``.  ``valid`` masks the
+    per-device padding (power-law blocks pad unevenly under ``nodes``).
+    """
+
+    strategy: str
+    n: int  # real node count
+    n_pad: int  # D * block
+    block: int  # nodes per device block
+    src: np.ndarray  # int32 [D, E_dev]
+    dst: np.ndarray  # int32 [D, E_dev]
+    valid: np.ndarray  # f [D, E_dev]
+    inv_outdeg: np.ndarray  # f [n_pad]
+    dangling: np.ndarray  # f [n_pad] (padding rows are NOT dangling: 0)
+    pad_frac: float  # fraction of padded edge slots (load-imbalance gauge)
+
+
+def partition_graph(
+    graph: Graph, n_devices: int, *, strategy: str = "edges", dtype: str = "float32"
+) -> ShardedGraph:
+    """Partition once on host (the reference partitions on every shuffle)."""
+    if strategy not in ("edges", "nodes"):
+        raise ValueError(f"unknown shard strategy {strategy!r}")
+    d = n_devices
+    n = graph.n_nodes
+    block = max(1, math.ceil(n / d))
+    n_pad = block * d
+    e = graph.n_edges
+
+    inv = np.zeros(n_pad, dtype)
+    with np.errstate(divide="ignore"):
+        inv[:n] = np.where(graph.out_degree > 0, 1.0 / np.maximum(graph.out_degree, 1), 0.0)
+    dangling = np.zeros(n_pad, dtype)
+    dangling[:n] = (graph.out_degree == 0).astype(dtype)
+
+    if strategy == "edges":
+        e_dev = max(1, math.ceil(e / d))
+        cap = e_dev * d
+        src = np.full(cap, 0, np.int32)
+        dst = np.full(cap, n_pad - 1, np.int32)  # keeps dst sorted per slice tail
+        valid = np.zeros(cap, dtype)
+        src[:e] = graph.src
+        dst[:e] = graph.dst
+        valid[:e] = 1.0
+        pad_frac = (cap - e) / max(cap, 1)
+        return ShardedGraph(strategy, n, n_pad, block,
+                            src.reshape(d, e_dev), dst.reshape(d, e_dev),
+                            valid.reshape(d, e_dev), inv, dangling, pad_frac)
+
+    # nodes strategy: split edges at dst block boundaries; pad each block's
+    # slice to the max block edge count (the power-law imbalance cost).
+    bounds = np.searchsorted(graph.dst, np.arange(0, n_pad + 1, block))
+    per = np.diff(bounds)
+    e_dev = max(1, int(per.max()))
+    src = np.zeros((d, e_dev), np.int32)
+    dst_local = np.full((d, e_dev), block - 1, np.int32)
+    valid = np.zeros((d, e_dev), dtype)
+    for i in range(d):
+        lo, hi = bounds[i], bounds[i + 1]
+        k = hi - lo
+        src[i, :k] = graph.src[lo:hi]
+        dst_local[i, :k] = graph.dst[lo:hi] - i * block
+        valid[i, :k] = 1.0
+    pad_frac = (d * e_dev - e) / max(d * e_dev, 1)
+    return ShardedGraph(strategy, n, n_pad, block, src, dst_local, valid,
+                        inv, dangling, pad_frac)
+
+
+def _restart_padded(sg: ShardedGraph, cfg: PageRankConfig) -> np.ndarray:
+    e = np.zeros(sg.n_pad, cfg.dtype)
+    e[: sg.n] = ops.restart_vector(sg.n, cfg)
+    return e
+
+
+def _init_padded(sg: ShardedGraph, cfg: PageRankConfig) -> np.ndarray:
+    r = np.zeros(sg.n_pad, cfg.dtype)
+    r[: sg.n] = ops.init_ranks(sg.n, cfg)
+    return r
+
+
+def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
+    """Compile the sharded iteration loop.
+
+    Returns ``run(device_arrays...) -> (ranks [n_pad], iters, delta)`` with
+    ranks replicated (``edges``) or node-sharded (``nodes``) on exit.
+    """
+    if cfg.spark_exact:
+        raise NotImplementedError(
+            "spark_exact is a single-chip parity mode; run it without a mesh"
+        )
+    if cfg.spmv_impl != "segment":
+        raise NotImplementedError(
+            f"spmv_impl={cfg.spmv_impl!r} is not wired into the sharded "
+            "runner yet; use 'segment' with --mesh"
+        )
+    axis = mesh.axis_names[0]
+    damping = cfg.damping
+    total_mass = float(sg.n) if cfg.init is RankInit.ONE else 1.0
+    redistribute = cfg.dangling is DanglingMode.REDISTRIBUTE
+    n_pad, block = sg.n_pad, sg.block
+
+    if sg.strategy == "edges":
+        # state: replicated full rank vector; one psum per iteration.
+        def step(ranks, src, dst, valid, inv, dang, e):
+            weighted = ranks * inv
+            per_edge = weighted[src[0]] * valid[0]
+            partial = jax.ops.segment_sum(
+                per_edge, dst[0], num_segments=n_pad, indices_are_sorted=True
+            )
+            contribs = coll.psum(partial, axis)  # the reduceByKey, on ICI
+            if redistribute:
+                contribs = contribs + jnp.sum(ranks * dang) * e
+            return (1.0 - damping) * total_mass * e + damping * contribs
+
+        state_spec = P()  # replicated ranks
+        local_delta = lambda new, old: jnp.sum(jnp.abs(new - old))
+    else:
+        # state: [block] rank shard per device; all_gather + dangling psum.
+        def step(ranks_b, src, dst_local, valid, inv, dang, e):
+            inv_b = lax.dynamic_slice_in_dim(inv, coll.axis_index(axis) * block, block)
+            weighted_full = coll.all_gather(ranks_b * inv_b, axis)
+            per_edge = weighted_full[src[0]] * valid[0]
+            contrib_b = jax.ops.segment_sum(
+                per_edge, dst_local[0], num_segments=block, indices_are_sorted=True
+            )
+            e_b = lax.dynamic_slice_in_dim(e, coll.axis_index(axis) * block, block)
+            if redistribute:
+                dang_b = lax.dynamic_slice_in_dim(
+                    dang, coll.axis_index(axis) * block, block
+                )
+                dmass = coll.psum(jnp.sum(ranks_b * dang_b), axis)
+                contrib_b = contrib_b + dmass * e_b
+            return (1.0 - damping) * total_mass * e_b + damping * contrib_b
+
+        state_spec = P(axis)
+        local_delta = lambda new, old: coll.psum(jnp.sum(jnp.abs(new - old)), axis)
+
+    def loop(ranks0, src, dst, valid, inv, dang, e):
+        if cfg.tol > 0.0:
+            def cond(carry):
+                _, delta, it = carry
+                return jnp.logical_and(delta > cfg.tol, it < cfg.iterations)
+
+            def body(carry):
+                ranks, _, it = carry
+                new = step(ranks, src, dst, valid, inv, dang, e)
+                return new, local_delta(new, ranks), it + 1
+
+            init = (ranks0, jnp.array(jnp.inf, ranks0.dtype), jnp.array(0, jnp.int32))
+            ranks, delta, it = lax.while_loop(cond, body, init)
+            return ranks, it, delta
+
+        def body(ranks, _):
+            new = step(ranks, src, dst, valid, inv, dang, e)
+            return new, local_delta(new, ranks)
+
+        ranks, deltas = lax.scan(body, ranks0, None, length=cfg.iterations)
+        last = deltas[-1] if cfg.iterations > 0 else jnp.array(jnp.inf, ranks0.dtype)
+        return ranks, jnp.array(cfg.iterations, jnp.int32), last
+
+    edge_spec = P(axis, None)
+    mapped = shard_map(
+        loop,
+        mesh=mesh,
+        in_specs=(state_spec, edge_spec, edge_spec, edge_spec, P(), P(), P()),
+        out_specs=(state_spec, P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def device_put_sharded_graph(sg: ShardedGraph, mesh: Mesh):
+    axis = mesh.axis_names[0]
+    esh = NamedSharding(mesh, P(axis, None))
+    rep = NamedSharding(mesh, P())
+    return (
+        jax.device_put(sg.src, esh),
+        jax.device_put(sg.dst, esh),
+        jax.device_put(sg.valid, esh),
+        jax.device_put(sg.inv_outdeg, rep),
+        jax.device_put(sg.dangling, rep),
+    )
+
+
+def run_pagerank_sharded(
+    graph: Graph,
+    cfg: PageRankConfig,
+    *,
+    n_devices: int | None = None,
+    mesh: Mesh | None = None,
+    strategy: str = "edges",
+    metrics: MetricsRecorder | None = None,
+    resume: bool = False,
+) -> PageRankResult:
+    """Sharded counterpart of models.pagerank.run_pagerank — same semantics
+    flags, same checkpoint segments, ranks bit-comparable across device
+    counts up to float reduction order (chip-count invariance is pinned by
+    tests/test_parallel.py)."""
+    metrics = metrics or MetricsRecorder()
+    if mesh is None:
+        mesh = make_mesh(n_devices, NODES_AXIS)
+    d = mesh.devices.size
+    if graph.n_nodes == 0:
+        return PageRankResult(np.zeros(0, cfg.dtype), 0, 0.0, metrics)
+
+    with Timer() as t_part:
+        sg = partition_graph(graph, d, strategy=strategy, dtype=cfg.dtype)
+        dev = device_put_sharded_graph(sg, mesh)
+    metrics.record(
+        event="partition", strategy=strategy, devices=d, block=sg.block,
+        edges_per_device=int(sg.src.shape[1]), pad_frac=round(sg.pad_frac, 4),
+        secs=t_part.elapsed,
+    )
+
+    e_vec = jax.device_put(_restart_padded(sg, cfg), NamedSharding(mesh, P()))
+    ranks_np = _init_padded(sg, cfg)
+    start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks_np) if resume else 0
+
+    axis = mesh.axis_names[0]
+    state_sharding = (
+        NamedSharding(mesh, P()) if sg.strategy == "edges" else NamedSharding(mesh, P(axis))
+    )
+    ranks_dev = jax.device_put(ranks_np, state_sharding)
+
+    def invoke(runner, rd):
+        rd, iters, delta = runner(rd, *dev[:3], *dev[3:], e_vec)
+        delta = float(delta)  # scalar fetch is the only reliable device sync
+        return rd, iters, delta
+
+    ranks_dev, done, last_delta = driver.run_segments(
+        cfg, metrics, ranks_dev, start_iter,
+        make_runner=lambda seg_cfg: make_sharded_runner(sg, seg_cfg, mesh),
+        invoke=invoke,
+        extract_np=lambda rd: np.asarray(rd)[: sg.n],
+        extra_metrics={"devices": d},
+    )
+    return PageRankResult(
+        ranks=np.asarray(ranks_dev)[: sg.n], iterations=done,
+        l1_delta=last_delta, metrics=metrics,
+    )

@@ -96,13 +96,14 @@ class PageRankConfig:
             raise ValueError(f"iterations must be >= 0, got {self.iterations}")
         if not 0.0 <= self.damping <= 1.0:
             raise ValueError(f"damping must be in [0, 1], got {self.damping}")
+        # Accept plain strings for enum fields (CLI / JSON round-trips) —
+        # coerce BEFORE any enum-identity validation below.
+        object.__setattr__(self, "dangling", DanglingMode(self.dangling))
+        object.__setattr__(self, "init", RankInit(self.init))
         if self.spark_exact and self.dangling is not DanglingMode.DROP:
             raise ValueError("spark_exact requires dangling=drop")
         if self.spmv_impl not in ("segment", "bcoo", "pallas"):
             raise ValueError(f"unknown spmv_impl {self.spmv_impl!r}")
-        # Accept plain strings for enum fields (CLI / JSON round-trips).
-        object.__setattr__(self, "dangling", DanglingMode(self.dangling))
-        object.__setattr__(self, "init", RankInit(self.init))
         if self.personalize is not None:
             object.__setattr__(self, "personalize", tuple(int(x) for x in self.personalize))
 
