@@ -20,10 +20,17 @@ from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsReco
 
 
 def resume_from_checkpoint(
-    cfg: PageRankConfig, metrics: MetricsRecorder, ranks_np: np.ndarray
+    cfg: PageRankConfig, metrics: MetricsRecorder, ranks_np: np.ndarray, *, n: int
 ) -> int:
-    """Load the latest checkpoint into ``ranks_np`` (in place, first
-    ``len(arrays['ranks'])`` rows); returns the start iteration."""
+    """Load the latest checkpoint into ``ranks_np`` (in place, first ``n``
+    rows — ``ranks_np`` may carry shard padding beyond the logical node
+    count); returns the start iteration.
+
+    Checkpoints always store exactly the logical ``n`` ranks, so a size
+    mismatch means the checkpoint belongs to a different graph (the config
+    hash can't catch that: it excludes the input) and must fail loudly
+    rather than partially initialize.
+    """
     if not cfg.checkpoint_dir:
         raise ValueError("resume=True requires checkpoint_dir")
     latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
@@ -31,7 +38,12 @@ def resume_from_checkpoint(
         return 0
     start_iter, arrays, _ = ckpt.load_checkpoint(latest, cfg.config_hash())
     saved = arrays["ranks"]
-    ranks_np[: saved.shape[0]] = saved
+    if saved.shape[0] != n:
+        raise ValueError(
+            f"checkpoint {latest} holds {saved.shape[0]} ranks but the graph "
+            f"has {n} nodes; refusing to resume from a different graph"
+        )
+    ranks_np[:n] = saved
     metrics.record(event="resume", path=latest, start_iter=start_iter)
     return start_iter
 

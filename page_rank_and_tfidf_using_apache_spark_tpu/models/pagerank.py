@@ -53,7 +53,7 @@ def run_pagerank(
     dg = ops.put_graph(graph, cfg.dtype)
     e = jax.device_put(ops.restart_vector(n, cfg))
     ranks = np.asarray(ops.init_ranks(n, cfg))
-    start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks) if resume else 0
+    start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks, n=n) if resume else 0
     ranks_dev = jax.device_put(ranks.astype(cfg.dtype))
 
     make = ops.make_spark_exact_runner if cfg.spark_exact else ops.make_pagerank_runner

@@ -26,7 +26,7 @@ import numpy as np
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, TfMode
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 
 
@@ -122,6 +122,100 @@ def grow_chunk_cap(
     return cap, changed
 
 
+def resume_ingest(
+    cfg: TfidfConfig, metrics: MetricsRecorder
+) -> tuple[int, np.ndarray, list, list, int]:
+    """Load the latest ingest checkpoint (streaming and sharded paths share
+    the format).  Returns ``(chunk_index, df_total, parts, doc_length_parts,
+    n_docs)`` — zeros/empties when no checkpoint exists."""
+    if not cfg.checkpoint_dir:
+        raise ValueError("resume=True requires checkpoint_dir")
+    df_total = np.zeros(cfg.vocab_size, cfg.dtype)
+    latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+    if latest is None:
+        return 0, df_total, [], [], 0
+    chunk_index, arrays, extra = ckpt.load_checkpoint(latest, cfg.config_hash())
+    n_docs = int(extra["n_docs"])
+    parts = [(arrays["doc"], arrays["term"], arrays["count"])]
+    doc_length_parts = [arrays["doc_lengths"]]
+    metrics.record(event="resume", path=latest, chunk=chunk_index, docs=n_docs)
+    return chunk_index, arrays["df"], parts, doc_length_parts, n_docs
+
+
+def save_ingest_checkpoint(
+    cfg: TfidfConfig,
+    metrics: MetricsRecorder,
+    chunk_index: int,
+    df_total: np.ndarray,
+    parts: list,
+    doc_length_parts: list,
+    n_docs: int,
+) -> tuple[list, list]:
+    """Snapshot accumulated ingest state; returns the (compacted) part
+    lists so callers keep host memory flat across checkpoints."""
+    doc_a, term_a, count_a = (np.concatenate(x) for x in zip(*parts))
+    parts = [(doc_a, term_a, count_a)]
+    doc_length_parts = [np.concatenate(doc_length_parts)]
+    path = ckpt.save_checkpoint(
+        cfg.checkpoint_dir,
+        chunk_index,
+        {
+            "df": df_total, "doc": doc_a, "term": term_a, "count": count_a,
+            "doc_lengths": doc_length_parts[0],
+        },
+        cfg.config_hash(),
+        extra={"n_docs": n_docs},
+    )
+    metrics.record(event="checkpoint", path=path, chunk=chunk_index)
+    return parts, doc_length_parts
+
+
+def finalize_tfidf(
+    parts: list,
+    doc_length_parts: list,
+    df_total: np.ndarray,
+    n_docs: int,
+    cfg: TfidfConfig,
+    metrics: MetricsRecorder,
+) -> TfidfOutput:
+    """Second pass shared by the streaming and sharded ingest paths: IDF
+    join + TF weighting + optional L2 normalize, in numpy (the per-pair math
+    is elementwise; the heavy segment reductions already ran on device)."""
+    dtype = cfg.dtype
+    if not parts:
+        z = np.zeros(0, np.int32)
+        return TfidfOutput(0, cfg.vocab_bits, z, z, np.zeros(0, dtype),
+                           df_total, np.zeros(cfg.vocab_size, dtype), metrics)
+
+    doc_a = np.concatenate([p[0] for p in parts])
+    term_a = np.concatenate([p[1] for p in parts])
+    count_a = np.concatenate([p[2] for p in parts]).astype(dtype)
+    doc_lengths = np.concatenate(doc_length_parts)
+
+    idf = np.asarray(
+        ops.idf_vector(jnp.asarray(df_total), float(max(n_docs, 1)), cfg.idf_mode)
+    )
+    if cfg.tf_mode is TfMode.RAW:
+        tf = count_a
+    elif cfg.tf_mode is TfMode.FREQ:
+        tf = count_a / np.maximum(doc_lengths[doc_a].astype(dtype), 1.0)
+    else:  # LOGNORM
+        tf = np.where(count_a > 0, 1.0 + np.log(count_a), 0.0).astype(dtype)
+    weight = tf * idf[term_a]
+    if cfg.l2_normalize:
+        sq = np.zeros(n_docs, dtype)
+        np.add.at(sq, doc_a, weight * weight)
+        weight = weight / np.sqrt(np.maximum(sq, 1e-30))[doc_a]
+
+    metrics.scalar("n_docs", n_docs)
+    metrics.scalar("nnz", int(doc_a.shape[0]))
+    return TfidfOutput(
+        n_docs=n_docs, vocab_bits=cfg.vocab_bits,
+        doc=doc_a, term=term_a, weight=weight.astype(dtype),
+        df=df_total, idf=idf, metrics=metrics,
+    )
+
+
 def _pad_chunk(
     corpus: tio.TokenizedCorpus, cap: int
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -162,16 +256,7 @@ def run_tfidf_streaming(
     cap = cfg.chunk_tokens
 
     if resume:
-        if not cfg.checkpoint_dir:
-            raise ValueError("resume=True requires checkpoint_dir")
-        latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
-        if latest is not None:
-            chunk_index, arrays, extra = ckpt.load_checkpoint(latest, cfg.config_hash())
-            df_total = arrays["df"]
-            n_docs = int(extra["n_docs"])
-            parts = [(arrays["doc"], arrays["term"], arrays["count"])]
-            doc_length_parts = [arrays["doc_lengths"]]
-            metrics.record(event="resume", path=latest, chunk=chunk_index, docs=n_docs)
+        chunk_index, df_total, parts, doc_length_parts, n_docs = resume_ingest(cfg, metrics)
 
     for i, docs in enumerate(doc_chunks):
         if i < chunk_index:
@@ -204,54 +289,8 @@ def run_tfidf_streaming(
             pairs=k, secs=t.elapsed,
         )
         if cfg.checkpoint_every > 0 and cfg.checkpoint_dir and chunk_index % cfg.checkpoint_every == 0:
-            doc_a, term_a, count_a = (np.concatenate(x) for x in zip(*parts))
-            parts = [(doc_a, term_a, count_a)]
-            doc_length_parts = [np.concatenate(doc_length_parts)]
-            path = ckpt.save_checkpoint(
-                cfg.checkpoint_dir,
-                chunk_index,
-                {
-                    "df": df_total, "doc": doc_a, "term": term_a, "count": count_a,
-                    "doc_lengths": doc_length_parts[0],
-                },
-                cfg.config_hash(),
-                extra={"n_docs": n_docs},
+            parts, doc_length_parts = save_ingest_checkpoint(
+                cfg, metrics, chunk_index, df_total, parts, doc_length_parts, n_docs
             )
-            metrics.record(event="checkpoint", path=path, chunk=chunk_index)
 
-    if not parts:
-        z = np.zeros(0, np.int32)
-        return TfidfOutput(0, cfg.vocab_bits, z, z, np.zeros(0, dtype),
-                           df_total, np.zeros(vocab, dtype), metrics)
-
-    doc_a = np.concatenate([p[0] for p in parts])
-    term_a = np.concatenate([p[1] for p in parts])
-    count_a = np.concatenate([p[2] for p in parts]).astype(dtype)
-    doc_lengths = np.concatenate(doc_length_parts)
-
-    # Second pass: IDF join + weights, in numpy (the per-pair math is
-    # elementwise; the heavy segment reductions already ran on device).
-    idf = np.asarray(
-        ops.idf_vector(jnp.asarray(df_total), float(max(n_docs, 1)), cfg.idf_mode)
-    )
-    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfMode
-
-    if cfg.tf_mode is TfMode.RAW:
-        tf = count_a
-    elif cfg.tf_mode is TfMode.FREQ:
-        tf = count_a / np.maximum(doc_lengths[doc_a].astype(dtype), 1.0)
-    else:  # LOGNORM
-        tf = np.where(count_a > 0, 1.0 + np.log(count_a), 0.0).astype(dtype)
-    weight = tf * idf[term_a]
-    if cfg.l2_normalize:
-        sq = np.zeros(n_docs, dtype)
-        np.add.at(sq, doc_a, weight * weight)
-        weight = weight / np.sqrt(np.maximum(sq, 1e-30))[doc_a]
-
-    metrics.scalar("n_docs", n_docs)
-    metrics.scalar("nnz", int(doc_a.shape[0]))
-    return TfidfOutput(
-        n_docs=n_docs, vocab_bits=cfg.vocab_bits,
-        doc=doc_a, term=term_a, weight=weight.astype(dtype),
-        df=df_total, idf=idf, metrics=metrics,
-    )
+    return finalize_tfidf(parts, doc_length_parts, df_total, n_docs, cfg, metrics)

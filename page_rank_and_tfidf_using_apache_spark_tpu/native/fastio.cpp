@@ -1,0 +1,246 @@
+// Native host-side ingest kernels, bound via ctypes (utils/native.py).
+//
+// Reference counterpart: the JVM/native machinery Spark puts under its ingest
+// path (SURVEY.md §2 native-code note).  The rebuild's device-side native
+// layer is XLA; this file is the host-side native layer covering the two
+// ingest loops SURVEY.md §7 flags as Python bottlenecks at scale:
+//
+//   1. SNAP edge-list parse (soc-LiveJournal1: 69M edges of text) — the
+//      reference's `sc.textFile(edges).map(parse)` (SURVEY.md A2).
+//   2. Tokenize + FNV-1a-hash (Wikipedia-scale streaming TF-IDF ingest) —
+//      the reference's `flatMap(tokenize)` (SURVEY.md A7).
+//
+// Both must produce BIT-IDENTICAL output to the numpy fallbacks in
+// io/graph.py and io/text.py; tests/test_native.py pins them equal.  Any
+// input the numpy path would reject (non-integer edge tokens, odd token
+// count) makes these return -1 so the caller falls back and surfaces the
+// same Python-side error.
+//
+// Tokenizer semantics (must track io/text.py tokenize()): split on
+// non-[A-Za-z0-9] bytes, optional ASCII lowercasing, drop tokens shorter
+// than min_token_len.  Multi-byte UTF-8 sequences are all >= 0x80 so they
+// act as separators in both implementations; the only divergence from
+// Python's str.lower() is exotic Unicode whose lowercase form introduces
+// ASCII letters (e.g. U+212A KELVIN SIGN -> 'k'), which no real corpus in
+// scope contains.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// SNAP edge-list parsing
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline bool is_ws(uint8_t c) {
+  // Python str.split()/lstrip() whitespace, restricted to ASCII.
+  return c == ' ' || c == '\t' || c == '\r' || c == '\n' || c == '\v' ||
+         c == '\f';
+}
+
+inline bool is_line_ws(uint8_t c) { return c == ' ' || c == '\t' || c == '\v' || c == '\f'; }
+
+// Parse integer tokens from SNAP text.  When src/dst are non-null, fill
+// them; always return the number of (src, dst) pairs, or -1 on any token
+// the numpy path would reject (non-integer token, odd token count).
+int64_t parse_edges_impl(const uint8_t* buf, int64_t n, int64_t* src,
+                         int64_t* dst) {
+  int64_t count = 0;  // integer tokens seen
+  int64_t i = 0;
+  while (i < n) {
+    // Start of a line: skip leading blanks, then check for '#' comment.
+    int64_t j = i;
+    while (j < n && is_line_ws(buf[j])) j++;
+    if (j < n && buf[j] == '#') {
+      while (j < n && buf[j] != '\n') j++;
+      i = j + 1;
+      continue;
+    }
+    // Parse tokens until end of line.
+    while (j < n && buf[j] != '\n') {
+      if (is_ws(buf[j])) {
+        j++;
+        continue;
+      }
+      bool neg = false;
+      if (buf[j] == '-') {
+        neg = true;
+        j++;
+      }
+      if (j >= n || buf[j] < '0' || buf[j] > '9') return -1;
+      int64_t v = 0;
+      while (j < n && buf[j] >= '0' && buf[j] <= '9') {
+        int digit = buf[j] - '0';
+        // int64 overflow: numpy's parse raises here, so bail to the
+        // fallback instead of wrapping silently.
+        if (v > (INT64_MAX - digit) / 10) return -1;
+        v = v * 10 + digit;
+        j++;
+      }
+      if (j < n && !is_ws(buf[j])) return -1;  // e.g. "12abc"
+      if (neg) v = -v;
+      if (src != nullptr) {
+        if (count % 2 == 0) {
+          src[count / 2] = v;
+        } else {
+          dst[count / 2] = v;
+        }
+      }
+      count++;
+    }
+    i = j + 1;
+  }
+  if (count % 2 != 0) return -1;
+  return count / 2;
+}
+
+}  // namespace
+
+int64_t parse_edges_count(const uint8_t* buf, int64_t n) {
+  return parse_edges_impl(buf, n, nullptr, nullptr);
+}
+
+int64_t parse_edges_fill(const uint8_t* buf, int64_t n, int64_t* src,
+                         int64_t* dst) {
+  return parse_edges_impl(buf, n, src, dst);
+}
+
+// ---------------------------------------------------------------------------
+// Tokenize + FNV-1a hash
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr uint64_t kFnvOffset = 14695981039346656037ULL;
+constexpr uint64_t kFnvPrime = 1099511628211ULL;
+
+inline bool is_alnum(uint8_t c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9');
+}
+
+inline uint8_t to_lower(uint8_t c) {
+  return (c >= 'A' && c <= 'Z') ? c + ('a' - 'A') : c;
+}
+
+inline uint64_t fnv1a(const uint8_t* p, int64_t len, uint64_t h = kFnvOffset) {
+  for (int64_t i = 0; i < len; i++) h = (h ^ p[i]) * kFnvPrime;
+  return h;
+}
+
+struct TokenSpan {
+  int64_t start;  // into the per-doc lowered scratch buffer
+  int64_t len;
+};
+
+// Tokenize one document (bytes [p, p+len)) into `scratch` + `spans`.
+void tokenize_doc(const uint8_t* p, int64_t len, bool lowercase,
+                  int64_t min_token_len, std::string* scratch,
+                  std::vector<TokenSpan>* spans) {
+  scratch->clear();
+  spans->clear();
+  int64_t i = 0;
+  while (i < len) {
+    while (i < len && !is_alnum(p[i])) i++;
+    int64_t start = i;
+    while (i < len && is_alnum(p[i])) i++;
+    int64_t tlen = i - start;
+    if (tlen == 0 || tlen < min_token_len) continue;
+    TokenSpan span{static_cast<int64_t>(scratch->size()), tlen};
+    for (int64_t k = start; k < i; k++) {
+      scratch->push_back(static_cast<char>(
+          lowercase ? to_lower(p[k]) : p[k]));
+    }
+    spans->push_back(span);
+  }
+}
+
+// Number of emitted terms for m unigrams with n-grams up to `ngram`
+// (matches io/text.py add_ngrams: unigrams, then 2-grams, ... n-grams).
+inline int64_t term_count(int64_t m, int64_t ngram) {
+  int64_t total = m;
+  for (int64_t k = 2; k <= ngram; k++) {
+    if (m - k + 1 > 0) total += m - k + 1;
+  }
+  return total;
+}
+
+}  // namespace
+
+// Count total emitted terms across all docs.  `blob` is the concatenation
+// of the docs' UTF-8 bytes; `doc_lens[d]` is doc d's byte length.
+int64_t tokenize_hash_count(const uint8_t* blob, int64_t blob_len,
+                            const int64_t* doc_lens, int64_t n_docs,
+                            int64_t ngram, int64_t lowercase,
+                            int64_t min_token_len) {
+  (void)blob_len;
+  std::string scratch;
+  std::vector<TokenSpan> spans;
+  int64_t total = 0;
+  int64_t off = 0;
+  for (int64_t d = 0; d < n_docs; d++) {
+    tokenize_doc(blob + off, doc_lens[d], lowercase != 0, min_token_len,
+                 &scratch, &spans);
+    total += term_count(static_cast<int64_t>(spans.size()), ngram);
+    off += doc_lens[d];
+  }
+  return total;
+}
+
+// Fill doc_ids/term_ids (int32 [total]) and doc_lengths (int32 [n_docs]).
+// Emission order per doc matches add_ngrams: all unigrams in text order,
+// then all 2-grams, then 3-grams, ...  n-gram hashes cover the bytes of
+// the space-joined lowered tokens, identically to hashing the joined
+// Python string.  Returns total terms written, or -1 on overflow vs the
+// caller-allocated capacity implied by tokenize_hash_count.
+int64_t tokenize_hash_fill(const uint8_t* blob, int64_t blob_len,
+                           const int64_t* doc_lens, int64_t n_docs,
+                           int64_t ngram, int64_t lowercase,
+                           int64_t min_token_len, int64_t vocab_bits,
+                           int32_t* doc_ids, int32_t* term_ids,
+                           int32_t* doc_lengths) {
+  (void)blob_len;
+  const uint64_t mask = (vocab_bits >= 64)
+                            ? ~0ULL
+                            : ((1ULL << vocab_bits) - 1ULL);
+  std::string scratch;
+  std::vector<TokenSpan> spans;
+  int64_t out = 0;
+  int64_t off = 0;
+  for (int64_t d = 0; d < n_docs; d++) {
+    tokenize_doc(blob + off, doc_lens[d], lowercase != 0, min_token_len,
+                 &scratch, &spans);
+    const uint8_t* sp = reinterpret_cast<const uint8_t*>(scratch.data());
+    const int64_t m = static_cast<int64_t>(spans.size());
+    doc_lengths[d] = static_cast<int32_t>(term_count(m, ngram));
+    // Unigrams.
+    for (int64_t t = 0; t < m; t++) {
+      uint64_t h = fnv1a(sp + spans[t].start, spans[t].len);
+      doc_ids[out] = static_cast<int32_t>(d);
+      term_ids[out] = static_cast<int32_t>(h & mask);
+      out++;
+    }
+    // k-grams, k = 2..ngram: hash tok[i] ' ' tok[i+1] ' ' ... tok[i+k-1].
+    for (int64_t k = 2; k <= ngram; k++) {
+      for (int64_t t = 0; t + k <= m; t++) {
+        uint64_t h = kFnvOffset;
+        for (int64_t g = 0; g < k; g++) {
+          if (g > 0) h = (h ^ static_cast<uint8_t>(' ')) * kFnvPrime;
+          h = fnv1a(sp + spans[t + g].start, spans[t + g].len, h);
+        }
+        doc_ids[out] = static_cast<int32_t>(d);
+        term_ids[out] = static_cast<int32_t>(h & mask);
+        out++;
+      }
+    }
+    off += doc_lens[d];
+  }
+  return out;
+}
+
+}  // extern "C"

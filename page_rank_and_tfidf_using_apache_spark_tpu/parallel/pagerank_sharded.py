@@ -177,26 +177,26 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
             return (1.0 - damping) * total_mass * e + damping * contribs
 
         state_spec = P()  # replicated ranks
+        vec_spec = P()  # inv/dangling/e replicated (step reads the full vectors)
         local_delta = lambda new, old: jnp.sum(jnp.abs(new - old))
     else:
-        # state: [block] rank shard per device; all_gather + dangling psum.
-        def step(ranks_b, src, dst_local, valid, inv, dang, e):
-            inv_b = lax.dynamic_slice_in_dim(inv, coll.axis_index(axis) * block, block)
+        # state: [block] rank shard per device; inv/dangling/e are likewise
+        # node-sharded (per-chip HBM holds only 1/D of every [n_pad] vector,
+        # which is the whole point of this strategy); all_gather the
+        # degree-weighted ranks, psum only the dangling-mass scalar.
+        def step(ranks_b, src, dst_local, valid, inv_b, dang_b, e_b):
             weighted_full = coll.all_gather(ranks_b * inv_b, axis)
             per_edge = weighted_full[src[0]] * valid[0]
             contrib_b = jax.ops.segment_sum(
                 per_edge, dst_local[0], num_segments=block, indices_are_sorted=True
             )
-            e_b = lax.dynamic_slice_in_dim(e, coll.axis_index(axis) * block, block)
             if redistribute:
-                dang_b = lax.dynamic_slice_in_dim(
-                    dang, coll.axis_index(axis) * block, block
-                )
                 dmass = coll.psum(jnp.sum(ranks_b * dang_b), axis)
                 contrib_b = contrib_b + dmass * e_b
             return (1.0 - damping) * total_mass * e_b + damping * contrib_b
 
         state_spec = P(axis)
+        vec_spec = P(axis)
         local_delta = lambda new, old: coll.psum(jnp.sum(jnp.abs(new - old)), axis)
 
     def loop(ranks0, src, dst, valid, inv, dang, e):
@@ -226,7 +226,7 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
     mapped = shard_map(
         loop,
         mesh=mesh,
-        in_specs=(state_spec, edge_spec, edge_spec, edge_spec, P(), P(), P()),
+        in_specs=(state_spec, edge_spec, edge_spec, edge_spec, vec_spec, vec_spec, vec_spec),
         out_specs=(state_spec, P(), P()),
         check_vma=False,
     )
@@ -236,13 +236,16 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
 def device_put_sharded_graph(sg: ShardedGraph, mesh: Mesh):
     axis = mesh.axis_names[0]
     esh = NamedSharding(mesh, P(axis, None))
-    rep = NamedSharding(mesh, P())
+    # Node-state vectors follow the strategy: replicated under ``edges``
+    # (the step reads the full vectors), node-sharded under ``nodes`` (1/D
+    # per-chip HBM — the strategy's reason to exist).
+    vsh = NamedSharding(mesh, P() if sg.strategy == "edges" else P(axis))
     return (
         jax.device_put(sg.src, esh),
         jax.device_put(sg.dst, esh),
         jax.device_put(sg.valid, esh),
-        jax.device_put(sg.inv_outdeg, rep),
-        jax.device_put(sg.dangling, rep),
+        jax.device_put(sg.inv_outdeg, vsh),
+        jax.device_put(sg.dangling, vsh),
     )
 
 
@@ -276,14 +279,13 @@ def run_pagerank_sharded(
         secs=t_part.elapsed,
     )
 
-    e_vec = jax.device_put(_restart_padded(sg, cfg), NamedSharding(mesh, P()))
-    ranks_np = _init_padded(sg, cfg)
-    start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks_np) if resume else 0
-
     axis = mesh.axis_names[0]
     state_sharding = (
         NamedSharding(mesh, P()) if sg.strategy == "edges" else NamedSharding(mesh, P(axis))
     )
+    e_vec = jax.device_put(_restart_padded(sg, cfg), state_sharding)
+    ranks_np = _init_padded(sg, cfg)
+    start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks_np, n=sg.n) if resume else 0
     ranks_dev = jax.device_put(ranks_np, state_sharding)
 
     def invoke(runner, rd):

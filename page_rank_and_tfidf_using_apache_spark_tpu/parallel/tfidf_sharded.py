@@ -20,7 +20,6 @@ from __future__ import annotations
 from typing import Iterable, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -28,12 +27,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
     TfidfOutput,
+    finalize_tfidf,
     grow_chunk_cap,
+    resume_ingest,
+    save_ingest_checkpoint,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel import collectives as coll
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import DATA_AXIS, make_mesh
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, TfMode
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 
 
@@ -71,9 +73,14 @@ def run_tfidf_sharded(
     n_devices: int | None = None,
     mesh: Mesh | None = None,
     metrics: MetricsRecorder | None = None,
+    resume: bool = False,
 ) -> TfidfOutput:
     """Sharded counterpart of models.tfidf.run_tfidf_streaming: consumes the
-    same chunk iterator, ingesting D chunks per device step."""
+    same chunk iterator, ingesting D chunks per device step.  Checkpointing
+    shares the streaming path's format (``cfg.checkpoint_every`` counts input
+    *chunks*, not super-chunks, so a config moved between the two paths
+    checkpoints at the same cadence) and ``resume=True`` skips the
+    already-ingested prefix of the iterator."""
     metrics = metrics or MetricsRecorder()
     if mesh is None:
         mesh = make_mesh(n_devices, DATA_AXIS)
@@ -84,13 +91,22 @@ def run_tfidf_sharded(
 
     df_total = np.zeros(vocab, dtype)
     n_docs = 0
+    chunk_index = 0  # input chunks fully ingested
+    last_ckpt = 0
     parts: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
     doc_length_parts: list[np.ndarray] = []
     cap = cfg.chunk_tokens
     kernel = None
     esh = NamedSharding(mesh, P(axis, None))
 
+    if resume:
+        chunk_index, df_total, parts, doc_length_parts, n_docs = resume_ingest(cfg, metrics)
+        last_ckpt = chunk_index
+
     chunk_iter = iter(doc_chunks)
+    for _ in range(chunk_index):
+        if next(chunk_iter, None) is None:
+            break  # iterator shorter than the checkpoint; nothing left
     step = 0
     while True:
         group: list[tio.TokenizedCorpus] = []
@@ -139,41 +155,19 @@ def run_tfidf_sharded(
         for i in range(len(group)):
             k = int(n_pairs[i])
             parts.append((h_doc[i, :k], h_term[i, :k], h_cnt[i, :k]))
+        chunk_index += len(group)
         metrics.record(
             event="super_chunk", step=step, devices=len(group), docs=n_docs,
             tokens=int(sum(c.n_tokens for c in group)), secs=t.elapsed,
         )
         step += 1
+        if (
+            cfg.checkpoint_every > 0 and cfg.checkpoint_dir
+            and chunk_index - last_ckpt >= cfg.checkpoint_every
+        ):
+            parts, doc_length_parts = save_ingest_checkpoint(
+                cfg, metrics, chunk_index, df_total, parts, doc_length_parts, n_docs
+            )
+            last_ckpt = chunk_index
 
-    if not parts:
-        z = np.zeros(0, np.int32)
-        return TfidfOutput(0, cfg.vocab_bits, z, z, np.zeros(0, dtype),
-                           df_total, np.zeros(vocab, dtype), metrics)
-
-    doc_a = np.concatenate([p[0] for p in parts])
-    term_a = np.concatenate([p[1] for p in parts])
-    count_a = np.concatenate([p[2] for p in parts]).astype(dtype)
-    doc_lengths = np.concatenate(doc_length_parts)
-
-    idf = np.asarray(
-        ops.idf_vector(jnp.asarray(df_total), float(max(n_docs, 1)), cfg.idf_mode)
-    )
-    if cfg.tf_mode is TfMode.RAW:
-        tf = count_a
-    elif cfg.tf_mode is TfMode.FREQ:
-        tf = count_a / np.maximum(doc_lengths[doc_a].astype(dtype), 1.0)
-    else:
-        tf = np.where(count_a > 0, 1.0 + np.log(count_a), 0.0).astype(dtype)
-    weight = tf * idf[term_a]
-    if cfg.l2_normalize:
-        sq = np.zeros(n_docs, dtype)
-        np.add.at(sq, doc_a, weight * weight)
-        weight = weight / np.sqrt(np.maximum(sq, 1e-30))[doc_a]
-
-    metrics.scalar("n_docs", n_docs)
-    metrics.scalar("nnz", int(doc_a.shape[0]))
-    return TfidfOutput(
-        n_docs=n_docs, vocab_bits=cfg.vocab_bits,
-        doc=doc_a, term=term_a, weight=weight.astype(dtype),
-        df=df_total, idf=idf, metrics=metrics,
-    )
+    return finalize_tfidf(parts, doc_length_parts, df_total, n_docs, cfg, metrics)

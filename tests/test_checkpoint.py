@@ -58,6 +58,37 @@ def test_atomic_write_survives_partial_tmp(tmp_path):
     np.testing.assert_array_equal(arrays["x"], np.arange(4))
 
 
+def test_pagerank_resume_rejects_different_graph(tmp_path):
+    """The config hash excludes the input graph; a checkpoint from a
+    different-sized graph must fail loudly, not partially initialize."""
+    ckdir = str(tmp_path / "ck")
+    base = dict(iterations=6, checkpoint_every=2, checkpoint_dir=ckdir,
+                dangling="redistribute", init="uniform")
+    run_pagerank(synthetic_powerlaw(40, 120, seed=3), PageRankConfig(**base))
+    with pytest.raises(ValueError, match="different graph"):
+        run_pagerank(synthetic_powerlaw(80, 240, seed=3), PageRankConfig(**base),
+                     resume=True)
+
+
+def test_tfidf_sharded_checkpoint_resume(tmp_path):
+    """Sharded ingest checkpoints at the same chunk cadence as streaming and
+    resumes mid-corpus to the same result."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import run_tfidf_sharded
+
+    docs = [f"tok{i} tok{i % 5} shared word extra{i % 2}" for i in range(32)]
+    chunks = [docs[i : i + 2] for i in range(0, 32, 2)]
+    base = dict(vocab_bits=12, l2_normalize=True, idf_mode="smooth")
+    full = run_tfidf_sharded(iter(chunks), TfidfConfig(**base), n_devices=4)
+
+    ckdir = str(tmp_path / "ck")
+    cfg = TfidfConfig(**base, checkpoint_every=4, checkpoint_dir=ckdir)
+    run_tfidf_sharded(iter(chunks[:8]), cfg, n_devices=4)  # "crash" mid-corpus
+    assert ckpt.latest_checkpoint(ckdir) is not None
+    res = run_tfidf_sharded(iter(chunks), cfg, n_devices=4, resume=True)
+    assert res.n_docs == full.n_docs
+    np.testing.assert_allclose(res.to_dense(), full.to_dense(), atol=1e-6)
+
+
 def test_tfidf_streaming_resume(tmp_path):
     docs = [f"tok{i} tok{i % 3} shared word" for i in range(12)]
     chunks = [docs[i : i + 3] for i in range(0, 12, 3)]
