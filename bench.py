@@ -68,8 +68,11 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    # cumsum SpMV: the dst-sorted prefix-sum formulation, ~1.5x over
+    # segment_sum on v5e where XLA's scatter path dominates (ops/pagerank.py
+    # spmv_cumsum docstring has the accuracy analysis).
     cfg = PageRankConfig(iterations=iters, dangling="redistribute", init="uniform",
-                         dtype="float32")
+                         dtype="float32", spmv_impl="cumsum")
     n = graph.n_nodes
     dg = ops.put_graph(graph, cfg.dtype)
     e_dev = jax.device_put(ops.restart_vector(n, cfg))

@@ -19,10 +19,12 @@
 // Tokenizer semantics (must track io/text.py tokenize()): split on
 // non-[A-Za-z0-9] bytes, optional ASCII lowercasing, drop tokens shorter
 // than min_token_len.  Multi-byte UTF-8 sequences are all >= 0x80 so they
-// act as separators in both implementations; the only divergence from
-// Python's str.lower() is exotic Unicode whose lowercase form introduces
-// ASCII letters (e.g. U+212A KELVIN SIGN -> 'k'), which no real corpus in
-// scope contains.
+// act as separators in both implementations — with exactly two exceptions
+// when lowercasing: the only Unicode codepoints whose Python str.lower()
+// maps into ASCII are U+212A KELVIN SIGN (-> 'k', token continues) and
+// U+0130 LATIN CAPITAL I WITH DOT (-> 'i' + combining U+0307, which ends
+// the token after the 'i').  Both are handled below so Turkish/scientific
+// text tokenizes identically on the fast path and the numpy fallback.
 
 #include <cstdint>
 #include <cstring>
@@ -138,6 +140,26 @@ struct TokenSpan {
   int64_t len;
 };
 
+// Unicode whose Python str.lower() introduces ASCII (see header comment).
+// Returns the lowered ASCII byte and consumed length, or 0 if p[i] does not
+// start such a sequence.  `ends_token` is set for U+0130, whose lowercase
+// trailing combining mark (U+0307) terminates the token in the regex path.
+inline uint8_t special_lower(const uint8_t* p, int64_t len, int64_t i,
+                             int64_t* consumed, bool* ends_token) {
+  if (p[i] == 0xC4 && i + 1 < len && p[i + 1] == 0xB0) {  // U+0130
+    *consumed = 2;
+    *ends_token = true;
+    return 'i';
+  }
+  if (p[i] == 0xE2 && i + 2 < len && p[i + 1] == 0x84 &&
+      p[i + 2] == 0xAA) {  // U+212A KELVIN SIGN
+    *consumed = 3;
+    *ends_token = false;
+    return 'k';
+  }
+  return 0;
+}
+
 // Tokenize one document (bytes [p, p+len)) into `scratch` + `spans`.
 void tokenize_doc(const uint8_t* p, int64_t len, bool lowercase,
                   int64_t min_token_len, std::string* scratch,
@@ -145,19 +167,39 @@ void tokenize_doc(const uint8_t* p, int64_t len, bool lowercase,
   scratch->clear();
   spans->clear();
   int64_t i = 0;
-  while (i < len) {
-    while (i < len && !is_alnum(p[i])) i++;
-    int64_t start = i;
-    while (i < len && is_alnum(p[i])) i++;
-    int64_t tlen = i - start;
-    if (tlen == 0 || tlen < min_token_len) continue;
-    TokenSpan span{static_cast<int64_t>(scratch->size()), tlen};
-    for (int64_t k = start; k < i; k++) {
-      scratch->push_back(static_cast<char>(
-          lowercase ? to_lower(p[k]) : p[k]));
+  int64_t tok_start = -1;  // offset into scratch, -1 = not inside a token
+  auto end_token = [&]() {
+    if (tok_start >= 0) {
+      int64_t tlen = static_cast<int64_t>(scratch->size()) - tok_start;
+      if (tlen >= min_token_len) {
+        spans->push_back(TokenSpan{tok_start, tlen});
+      } else {
+        scratch->resize(tok_start);
+      }
+      tok_start = -1;
     }
-    spans->push_back(span);
+  };
+  while (i < len) {
+    int64_t consumed;
+    bool ends_token;
+    uint8_t lowered;
+    if (is_alnum(p[i])) {
+      if (tok_start < 0) tok_start = static_cast<int64_t>(scratch->size());
+      scratch->push_back(
+          static_cast<char>(lowercase ? to_lower(p[i]) : p[i]));
+      i++;
+    } else if (lowercase &&
+               (lowered = special_lower(p, len, i, &consumed, &ends_token))) {
+      if (tok_start < 0) tok_start = static_cast<int64_t>(scratch->size());
+      scratch->push_back(static_cast<char>(lowered));
+      if (ends_token) end_token();
+      i += consumed;
+    } else {
+      end_token();
+      i++;
+    }
   }
+  end_token();
 }
 
 // Number of emitted terms for m unigrams with n-grams up to `ngram`

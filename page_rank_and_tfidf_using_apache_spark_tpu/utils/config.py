@@ -102,8 +102,14 @@ class PageRankConfig:
         object.__setattr__(self, "init", RankInit(self.init))
         if self.spark_exact and self.dangling is not DanglingMode.DROP:
             raise ValueError("spark_exact requires dangling=drop")
-        if self.spmv_impl not in ("segment", "bcoo", "pallas"):
+        if self.spmv_impl not in ("segment", "bcoo", "cumsum", "pallas"):
             raise ValueError(f"unknown spmv_impl {self.spmv_impl!r}")
+        if self.spark_exact and self.spmv_impl == "cumsum":
+            # spark_exact's presence test counts unit contributions through
+            # the SpMV; a float32 prefix sum stops resolving +1.0 past 2^24
+            # accumulated mass, silently zeroing live nodes at large-graph
+            # scale.  spark_exact is a parity mode — keep it on exact impls.
+            raise ValueError("spark_exact requires spmv_impl='segment' or 'bcoo'")
         if self.personalize is not None:
             object.__setattr__(self, "personalize", tuple(int(x) for x in self.personalize))
 

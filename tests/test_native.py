@@ -97,6 +97,9 @@ DOCS = [
     "   punctuation-only:  ...!!!   ",
     "MiXeD CaSe 123 abc123def 42",
     "café naïve résumé",  # multi-byte UTF-8 acts as separator
+    "İstanbul is large",  # U+0130: lower() -> 'i' + combining dot (token break)
+    "300K is hot, AKB too",  # U+212A KELVIN: lower() -> ASCII 'k'
+    "İİ double dotted-İ edge İ",
     "a bb ccc dddd",
     "single",
 ]

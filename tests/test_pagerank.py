@@ -105,13 +105,25 @@ def test_tolerance_early_stop():
     assert res.l1_delta <= 1e-10
 
 
-def test_bcoo_impl_matches_segment():
+@pytest.mark.parametrize("impl", ["bcoo", "cumsum"])
+def test_spmv_impls_match_segment(impl):
     g = synthetic_powerlaw(100, 400, seed=7)
     r1 = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
                   spmv_impl="segment", dtype="float64")
     r2 = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
-                  spmv_impl="bcoo", dtype="float64")
+                  spmv_impl=impl, dtype="float64")
     assert np.abs(r1.ranks - r2.ranks).max() < 1e-12
+
+
+def test_cumsum_impl_f32_accuracy():
+    """The fast prefix-sum SpMV must stay rank-accurate in float32 at a
+    scale where its accumulated error could plausibly bite."""
+    g = synthetic_powerlaw(20_000, 100_000, seed=9)
+    exact = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
+                     spmv_impl="segment", dtype="float64")
+    fast = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
+                    spmv_impl="cumsum", dtype="float32")
+    assert np.abs(fast.ranks - exact.ranks).sum() < 1e-3
 
 
 def test_spark_default_config_shape():
@@ -146,3 +158,8 @@ def test_zero_iterations():
     g = _graph(EDGES_SMALL)
     res = pagerank(g, iterations=0)
     np.testing.assert_allclose(res.ranks, 1.0)
+
+
+def test_spark_exact_rejects_cumsum():
+    with pytest.raises(ValueError, match="spark_exact requires"):
+        PageRankConfig(spark_exact=True, dangling="drop", spmv_impl="cumsum")
