@@ -145,17 +145,16 @@ def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
     if impl == "cumsum":
         return spmv_cumsum(dg, weighted, n)
     if impl == "pallas":
-        try:
-            from page_rank_and_tfidf_using_apache_spark_tpu.ops.pallas_kernels import (
-                spmv_pallas,
-            )
-        except ImportError as exc:  # pragma: no cover
-            raise NotImplementedError(
-                "spmv_impl='pallas' requires ops/pallas_kernels.py, which is "
-                "not present in this build; use 'segment' or 'bcoo'"
-            ) from exc
+        from page_rank_and_tfidf_using_apache_spark_tpu.ops.pallas_kernels import (
+            spmv_pallas,
+        )
 
-        return spmv_pallas(dg.src, dg.dst, weighted, n)
+        if dg.indptr is None:
+            raise ValueError("spmv_impl='pallas' needs DeviceGraph.indptr (use put_graph)")
+        # Mosaic only compiles on real TPUs; everywhere else (CPU tests,
+        # simulated meshes) run the same kernel under the interpreter.
+        interpret = jax.default_backend() not in ("tpu", "axon")
+        return spmv_pallas(dg.src, dg.indptr, weighted, n=n, interpret=interpret)
     raise ValueError(f"unknown spmv impl {impl!r}")
 
 

@@ -104,7 +104,7 @@ class PageRankConfig:
             raise ValueError("spark_exact requires dangling=drop")
         if self.spmv_impl not in ("segment", "bcoo", "cumsum", "pallas"):
             raise ValueError(f"unknown spmv_impl {self.spmv_impl!r}")
-        if self.spark_exact and self.spmv_impl == "cumsum":
+        if self.spark_exact and self.spmv_impl in ("cumsum", "pallas"):
             # spark_exact's presence test counts unit contributions through
             # the SpMV; a float32 prefix sum stops resolving +1.0 past 2^24
             # accumulated mass, silently zeroing live nodes at large-graph

@@ -105,7 +105,7 @@ def test_tolerance_early_stop():
     assert res.l1_delta <= 1e-10
 
 
-@pytest.mark.parametrize("impl", ["bcoo", "cumsum"])
+@pytest.mark.parametrize("impl", ["bcoo", "cumsum", "pallas"])
 def test_spmv_impls_match_segment(impl):
     g = synthetic_powerlaw(100, 400, seed=7)
     r1 = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
@@ -160,6 +160,7 @@ def test_zero_iterations():
     np.testing.assert_allclose(res.ranks, 1.0)
 
 
-def test_spark_exact_rejects_cumsum():
+@pytest.mark.parametrize("impl", ["cumsum", "pallas"])
+def test_spark_exact_rejects_prefix_sum_impls(impl):
     with pytest.raises(ValueError, match="spark_exact requires"):
-        PageRankConfig(spark_exact=True, dangling="drop", spmv_impl="cumsum")
+        PageRankConfig(spark_exact=True, dangling="drop", spmv_impl=impl)
