@@ -51,6 +51,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-dir", help="jax.profiler trace output dir")
     p.add_argument("--mesh", type=int, default=0,
                    help="shard over this many devices (0 = single device)")
+    p.add_argument("--shard-strategy",
+                   choices=["edges", "nodes", "nodes_balanced"], default="edges",
+                   help="graph partition under --mesh: balanced edge slices / "
+                        "node blocks / edge-balanced node blocks (power-law)")
     return p
 
 
@@ -95,7 +99,8 @@ def main(argv: list[str] | None = None) -> int:
                 return 2
 
             result = pagerank_sharded.run_pagerank_sharded(
-                graph, cfg, n_devices=args.mesh, metrics=metrics, resume=args.resume
+                graph, cfg, n_devices=args.mesh, strategy=args.shard_strategy,
+                metrics=metrics, resume=args.resume,
             )
         else:
             result = run_pagerank(graph, cfg, metrics=metrics, resume=args.resume)

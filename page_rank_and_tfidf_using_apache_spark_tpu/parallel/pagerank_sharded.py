@@ -21,6 +21,15 @@ both exist):
   outgrows one chip's HBM (soc-LiveJournal1 config, BASELINE.json:9).
   Per iteration: ``all_gather`` the degree-weighted rank blocks, local
   segment_sum into the block, ``psum`` only for the dangling-mass scalar.
+- ``nodes_balanced``: same memory layout and iteration as ``nodes``, but the
+  node-block boundaries are chosen at equal *in-edge* splits instead of
+  equal node counts, so a power-law degree distribution (one celebrity node
+  next to millions of leaves) no longer concentrates most of the SpMV work
+  on one chip.  Node ids are relabeled into a padded per-device space on
+  host (``node_map``); the device program is identical to ``nodes``.  The
+  padded block is uniform (= the max device's node count), so per-device
+  node counts are capped at 2x the equal-node block — memory stays within
+  2x of ``nodes`` instead of degrading toward n*d on hub-heavy graphs.
 
 Both run the whole iteration loop inside one ``jit`` + ``shard_map``
 program: collectives are compiled into the loop body, so there are zero
@@ -77,27 +86,29 @@ class ShardedGraph(NamedTuple):
     inv_outdeg: np.ndarray  # f [n_pad]
     dangling: np.ndarray  # f [n_pad] (padding rows are NOT dangling: 0)
     pad_frac: float  # fraction of padded edge slots (load-imbalance gauge)
+    node_map: np.ndarray = None  # int64 [n]: global node id → padded slot
+    # (identity-into-prefix for 'edges'/'nodes'; a relabeling under
+    # 'nodes_balanced' where device blocks have unequal node counts)
 
 
 def partition_graph(
     graph: Graph, n_devices: int, *, strategy: str = "edges", dtype: str = "float32"
 ) -> ShardedGraph:
     """Partition once on host (the reference partitions on every shuffle)."""
-    if strategy not in ("edges", "nodes"):
+    if strategy not in ("edges", "nodes", "nodes_balanced"):
         raise ValueError(f"unknown shard strategy {strategy!r}")
     d = n_devices
     n = graph.n_nodes
-    block = max(1, math.ceil(n / d))
-    n_pad = block * d
     e = graph.n_edges
 
-    inv = np.zeros(n_pad, dtype)
-    with np.errstate(divide="ignore"):
-        inv[:n] = np.where(graph.out_degree > 0, 1.0 / np.maximum(graph.out_degree, 1), 0.0)
-    dangling = np.zeros(n_pad, dtype)
-    dangling[:n] = (graph.out_degree == 0).astype(dtype)
+    inv_g = np.where(
+        graph.out_degree > 0, 1.0 / np.maximum(graph.out_degree, 1), 0.0
+    ).astype(dtype)
+    dang_g = (graph.out_degree == 0).astype(dtype)
 
     if strategy == "edges":
+        block = max(1, math.ceil(n / d))
+        n_pad = block * d
         e_dev = max(1, math.ceil(e / d))
         cap = e_dev * d
         src = np.full(cap, 0, np.int32)
@@ -107,39 +118,81 @@ def partition_graph(
         dst[:e] = graph.dst
         valid[:e] = 1.0
         pad_frac = (cap - e) / max(cap, 1)
+        inv = np.zeros(n_pad, dtype)
+        inv[:n] = inv_g
+        dangling = np.zeros(n_pad, dtype)
+        dangling[:n] = dang_g
         return ShardedGraph(strategy, n, n_pad, block,
                             src.reshape(d, e_dev), dst.reshape(d, e_dev),
-                            valid.reshape(d, e_dev), inv, dangling, pad_frac)
+                            valid.reshape(d, e_dev), inv, dangling, pad_frac,
+                            np.arange(n, dtype=np.int64))
 
-    # nodes strategy: split edges at dst block boundaries; pad each block's
-    # slice to the max block edge count (the power-law imbalance cost).
-    bounds = np.searchsorted(graph.dst, np.arange(0, n_pad + 1, block))
-    per = np.diff(bounds)
+    # Node-sharded strategies: device i owns global nodes [b_i, b_{i+1})
+    # (their rank shard and their in-edges, which are contiguous in the
+    # dst-sorted edge array).  'nodes' picks equal-node boundaries; padding
+    # each device's edge slice to the max then bears the full power-law
+    # imbalance.  'nodes_balanced' picks boundaries at equal-EDGE splits
+    # (node-granular), evening out SpMV work instead.
+    if strategy == "nodes":
+        block = max(1, math.ceil(n / d))
+        bounds_nodes = np.minimum(np.arange(0, d + 1) * block, n)
+    else:
+        # Equal-edge boundaries, but with per-device node count capped at
+        # 2x the equal-node block: the uniform padded block is the max
+        # device's node count, so an uncapped edge-balanced split of a
+        # hub-heavy graph (hubs first, a huge low-degree tail on the last
+        # device) would push n_pad toward n*d and forfeit the 1/D memory
+        # scaling this layout exists for.  The cap bounds memory at 2x the
+        # 'nodes' layout while keeping edges near-balanced whenever the
+        # degree distribution allows.
+        cap = 2 * max(1, math.ceil(n / d))
+        indptr = np.searchsorted(graph.dst, np.arange(n + 1))
+        bounds_nodes = np.zeros(d + 1, np.int64)
+        for i in range(1, d):
+            target = int(np.searchsorted(indptr, (i * e) // d, side="left"))
+            lo = max(bounds_nodes[i - 1], n - (d - i) * cap)  # leave capacity
+            hi = min(bounds_nodes[i - 1] + cap, n)
+            bounds_nodes[i] = min(max(target, lo), hi)
+        bounds_nodes[d] = n
+        block = max(1, int(np.diff(bounds_nodes).max()))
+    n_pad = block * d
+
+    # global node id → padded slot (device i's nodes at [i*block, ...))
+    node_map = np.empty(n, np.int64)
+    for i in range(d):
+        lo, hi = bounds_nodes[i], bounds_nodes[i + 1]
+        node_map[lo:hi] = i * block + np.arange(hi - lo)
+
+    ebounds = np.searchsorted(graph.dst, bounds_nodes)
+    per = np.diff(ebounds)
     e_dev = max(1, int(per.max()))
     src = np.zeros((d, e_dev), np.int32)
     dst_local = np.full((d, e_dev), block - 1, np.int32)
     valid = np.zeros((d, e_dev), dtype)
+    src_mapped = node_map[graph.src].astype(np.int32)
     for i in range(d):
-        lo, hi = bounds[i], bounds[i + 1]
+        lo, hi = ebounds[i], ebounds[i + 1]
         k = hi - lo
-        src[i, :k] = graph.src[lo:hi]
-        dst_local[i, :k] = graph.dst[lo:hi] - i * block
+        src[i, :k] = src_mapped[lo:hi]
+        dst_local[i, :k] = graph.dst[lo:hi] - bounds_nodes[i]
         valid[i, :k] = 1.0
     pad_frac = (d * e_dev - e) / max(d * e_dev, 1)
+    inv = np.zeros(n_pad, dtype)
+    inv[node_map] = inv_g
+    dangling = np.zeros(n_pad, dtype)
+    dangling[node_map] = dang_g
     return ShardedGraph(strategy, n, n_pad, block, src, dst_local, valid,
-                        inv, dangling, pad_frac)
+                        inv, dangling, pad_frac, node_map)
+
+
+def _to_padded(sg: ShardedGraph, global_vec: np.ndarray, dtype: str) -> np.ndarray:
+    out = np.zeros(sg.n_pad, dtype)
+    out[sg.node_map] = global_vec
+    return out
 
 
 def _restart_padded(sg: ShardedGraph, cfg: PageRankConfig) -> np.ndarray:
-    e = np.zeros(sg.n_pad, cfg.dtype)
-    e[: sg.n] = ops.restart_vector(sg.n, cfg)
-    return e
-
-
-def _init_padded(sg: ShardedGraph, cfg: PageRankConfig) -> np.ndarray:
-    r = np.zeros(sg.n_pad, cfg.dtype)
-    r[: sg.n] = ops.init_ranks(sg.n, cfg)
-    return r
+    return _to_padded(sg, ops.restart_vector(sg.n, cfg), cfg.dtype)
 
 
 def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
@@ -284,9 +337,9 @@ def run_pagerank_sharded(
         NamedSharding(mesh, P()) if sg.strategy == "edges" else NamedSharding(mesh, P(axis))
     )
     e_vec = jax.device_put(_restart_padded(sg, cfg), state_sharding)
-    ranks_np = _init_padded(sg, cfg)
-    start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks_np, n=sg.n) if resume else 0
-    ranks_dev = jax.device_put(ranks_np, state_sharding)
+    ranks_g = ops.init_ranks(sg.n, cfg)
+    start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks_g, n=sg.n) if resume else 0
+    ranks_dev = jax.device_put(_to_padded(sg, ranks_g, cfg.dtype), state_sharding)
 
     def invoke(runner, rd):
         rd, iters, delta = runner(rd, *dev[:3], *dev[3:], e_vec)
@@ -297,10 +350,10 @@ def run_pagerank_sharded(
         cfg, metrics, ranks_dev, start_iter,
         make_runner=lambda seg_cfg: make_sharded_runner(sg, seg_cfg, mesh),
         invoke=invoke,
-        extract_np=lambda rd: np.asarray(rd)[: sg.n],
+        extract_np=lambda rd: np.asarray(rd)[sg.node_map],
         extra_metrics={"devices": d},
     )
     return PageRankResult(
-        ranks=np.asarray(ranks_dev)[: sg.n], iterations=done,
+        ranks=np.asarray(ranks_dev)[sg.node_map], iterations=done,
         l1_delta=last_delta, metrics=metrics,
     )

@@ -164,3 +164,25 @@ def test_zero_iterations():
 def test_spark_exact_rejects_prefix_sum_impls(impl):
     with pytest.raises(ValueError, match="spark_exact requires"):
         PageRankConfig(spark_exact=True, dangling="drop", spmv_impl=impl)
+
+
+def test_pallas_spmv_multi_chunk_carry(monkeypatch):
+    """The Pallas kernel's scalar carry must thread the prefix sum across
+    grid steps; shrink the chunk so a modest graph spans several chunks."""
+    import jax.numpy as jnp
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_CHUNK", 1024)
+    pk.spmv_pallas.clear_cache()
+    try:
+        g = synthetic_powerlaw(800, 5000, seed=11)
+        dg = ops.put_graph(g, "float64")
+        w = jnp.asarray(np.random.default_rng(2).random(g.n_nodes))
+        ref = ops.spmv_segment(dg, w, g.n_nodes)
+        got = pk.spmv_pallas(dg.src, dg.indptr, w, n=g.n_nodes, interpret=True)
+        assert int(np.ceil(g.n_edges / 1024)) > 3  # really multi-chunk
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-9)
+    finally:
+        pk.spmv_pallas.clear_cache()

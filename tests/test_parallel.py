@@ -32,7 +32,7 @@ def single_chip_ranks(graph):
 
 
 @pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
-@pytest.mark.parametrize("strategy", ["edges", "nodes"])
+@pytest.mark.parametrize("strategy", ["edges", "nodes", "nodes_balanced"])
 def test_chip_count_invariance(graph, single_chip_ranks, n_devices, strategy):
     res = run_pagerank_sharded(graph, CFG, n_devices=n_devices, strategy=strategy)
     assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9
@@ -89,11 +89,45 @@ def test_partition_edges_balanced(graph):
     assert (np.diff(sg.dst.ravel()[sg.valid.ravel() > 0]) >= 0).all()
 
 
-def test_partition_nodes_covers_all_edges(graph):
-    sg = partition_graph(graph, 8, strategy="nodes")
+@pytest.mark.parametrize("strategy", ["nodes", "nodes_balanced"])
+def test_partition_nodes_covers_all_edges(graph, strategy):
+    sg = partition_graph(graph, 8, strategy=strategy)
     assert int(sg.valid.sum()) == graph.n_edges
     # dst_local within block bounds
     assert (sg.dst >= 0).all() and (sg.dst < sg.block).all()
+    # node_map is a bijection into per-device slots
+    assert len(np.unique(sg.node_map)) == graph.n_nodes
+
+
+def test_partition_nodes_balanced_evens_powerlaw_edges():
+    """A hub-heavy graph: equal-node blocks concentrate in-edges on one
+    device; equal-edge boundaries must spread them to near-parity."""
+    rng = np.random.default_rng(0)
+    # 2000 nodes; node 0..3 receive ~90% of all edges (celebrities)
+    hubs = rng.integers(0, 4, 9000)
+    tail = rng.integers(4, 2000, 1000)
+    dst = np.concatenate([hubs, tail])
+    src = rng.integers(0, 2000, dst.size)
+    g = from_edges(src, dst)
+    plain = partition_graph(g, 8, strategy="nodes")
+    balanced = partition_graph(g, 8, strategy="nodes_balanced")
+
+    def max_real_edges(sg):
+        return int(sg.valid.sum(axis=1).max())
+
+    # plain 'nodes' puts ~all hub edges on device 0; balanced caps a device
+    # at roughly the largest single node's in-degree
+    assert max_real_edges(balanced) <= max_real_edges(plain) / 2
+    res_b = run_pagerank_sharded(
+        g, PageRankConfig(iterations=15, dangling="redistribute",
+                          init="uniform", dtype="float64"),
+        n_devices=8, strategy="nodes_balanced",
+    )
+    res_1 = run_pagerank(
+        g, PageRankConfig(iterations=15, dangling="redistribute",
+                          init="uniform", dtype="float64"),
+    )
+    assert np.abs(res_b.ranks - res_1.ranks).sum() <= 1e-9
 
 
 def test_spark_exact_sharded_raises(graph):
