@@ -42,7 +42,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bit-exact canonical Spark example semantics")
     p.add_argument("--personalize", type=int, nargs="+", default=None,
                    metavar="NODE", help="personalized PageRank source node(s)")
-    p.add_argument("--spmv-impl", choices=["segment", "bcoo", "cumsum", "pallas"], default="segment")
+    p.add_argument("--spmv-impl",
+                   choices=["segment", "bcoo", "cumsum", "pallas", "pallas_full"],
+                   default="segment")
     p.add_argument("--dtype", default="float32")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)

@@ -48,6 +48,16 @@ class Graph:
     def dangling_mask(self) -> np.ndarray:
         return self.out_degree == 0
 
+    def csr_indptr(self) -> np.ndarray:
+        """int64 [n_nodes+1] CSR row pointers into the dst-sorted edge array
+        (cached: every consumer — device graph build, shard partitioning,
+        Pallas window metadata — shares one host pass)."""
+        cached = getattr(self, "_indptr", None)
+        if cached is None:
+            cached = np.searchsorted(self.dst, np.arange(self.n_nodes + 1)).astype(np.int64)
+            object.__setattr__(self, "_indptr", cached)
+        return cached
+
     def __repr__(self) -> str:  # keep pytest output readable
         return f"Graph(n_nodes={self.n_nodes}, n_edges={self.n_edges})"
 

@@ -59,7 +59,7 @@ def put_graph(graph: Graph, dtype: str = "float32") -> DeviceGraph:
     outdeg = graph.out_degree.astype(dtype)
     with np.errstate(divide="ignore"):
         inv = np.where(outdeg > 0, 1.0 / np.maximum(outdeg, 1), 0.0).astype(dtype)
-    indptr = np.searchsorted(graph.dst, np.arange(graph.n_nodes + 1)).astype(np.int32)
+    indptr = graph.csr_indptr().astype(np.int32)
     return DeviceGraph(
         src=jnp.asarray(graph.src),
         dst=jnp.asarray(graph.dst),
@@ -137,24 +137,50 @@ def spmv_cumsum(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array
     return c0[dg.indptr[1:]] - c0[dg.indptr[:-1]]
 
 
-def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
+def pallas_full_meta(graph: Graph, dtype: str = "float32"):
+    """Host-side static metadata for spmv_impl='pallas_full': per-node-chunk
+    cumsum-window starts + uniform window size (see pallas_kernels).  Raises
+    when a window would blow the VMEM scratch budget — use 'pallas' then."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
+
+    starts, cap = pk.diff_window_meta(graph.csr_indptr(), graph.n_edges)
+    if cap * np.dtype(dtype).itemsize > 8 * 1024 * 1024:  # v5e VMEM scratch budget
+        raise ValueError(
+            f"pallas_full window cap {cap} x {dtype} exceeds the 8 MB VMEM "
+            "scratch budget (dense hub rows); use spmv_impl='pallas'"
+        )
+    return jnp.asarray(starts), cap
+
+
+def _spmv(
+    dg: DeviceGraph, weighted: jax.Array, n: int, impl: str, pallas_meta=None
+) -> jax.Array:
     if impl == "segment":
         return spmv_segment(dg, weighted, n)
     if impl == "bcoo":
         return spmv_bcoo(dg, weighted, n)
     if impl == "cumsum":
         return spmv_cumsum(dg, weighted, n)
-    if impl == "pallas":
-        from page_rank_and_tfidf_using_apache_spark_tpu.ops.pallas_kernels import (
-            spmv_pallas,
-        )
+    if impl in ("pallas", "pallas_full"):
+        from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
 
         if dg.indptr is None:
-            raise ValueError("spmv_impl='pallas' needs DeviceGraph.indptr (use put_graph)")
+            raise ValueError(f"spmv_impl={impl!r} needs DeviceGraph.indptr (use put_graph)")
         # Mosaic only compiles on real TPUs; everywhere else (CPU tests,
         # simulated meshes) run the same kernel under the interpreter.
         interpret = jax.default_backend() not in ("tpu", "axon")
-        return spmv_pallas(dg.src, dg.indptr, weighted, n=n, interpret=interpret)
+        if impl == "pallas":
+            return pk.spmv_pallas(dg.src, dg.indptr, weighted, n=n, interpret=interpret)
+        if pallas_meta is None:
+            raise ValueError(
+                "spmv_impl='pallas_full' needs window metadata; pass "
+                "pallas_meta=ops.pallas_full_meta(graph) to the runner"
+            )
+        starts, cap = pallas_meta
+        return pk.spmv_pallas_full(
+            dg.src, dg.indptr, weighted, n=n,
+            window_starts=starts, window_cap=cap, interpret=interpret,
+        )
     raise ValueError(f"unknown spmv impl {impl!r}")
 
 
@@ -168,6 +194,7 @@ def pagerank_step(
     dangling: DanglingMode,
     total_mass: float,
     impl: str = "segment",
+    pallas_meta=None,
 ) -> jax.Array:
     """One power-iteration step.
 
@@ -180,7 +207,7 @@ def pagerank_step(
     preserved every step.
     """
     weighted = ranks * dg.inv_outdeg
-    contribs = _spmv(dg, weighted, n, impl)
+    contribs = _spmv(dg, weighted, n, impl, pallas_meta)
     if dangling is DanglingMode.REDISTRIBUTE:
         # lost mass re-enters through the restart distribution e; on a
         # sharded mesh this sum is the lax.psum of BASELINE.json:5.
@@ -211,7 +238,7 @@ def spark_exact_step(
     return SparkExactState(ranks=ranks, present=present)
 
 
-def make_pagerank_runner(n: int, cfg: PageRankConfig):
+def make_pagerank_runner(n: int, cfg: PageRankConfig, *, pallas_meta=None):
     """Compile the full iteration loop into one XLA program.
 
     Returns ``run(dg, ranks0, e) -> (ranks, iters_done, final_delta)``.
@@ -219,7 +246,8 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig):
     reuses it); tolerance runs use ``lax.while_loop`` carrying the L1 delta.
     The Python-side driver loop of the reference (SURVEY.md §3.1 🔥 outer
     loop) disappears entirely — there are no host round-trips between
-    iterations.
+    iterations.  ``pallas_meta`` (from :func:`pallas_full_meta`) is required
+    for spmv_impl='pallas_full'.
     """
     damping = cfg.damping
     impl = cfg.spmv_impl
@@ -230,7 +258,7 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig):
         return pagerank_step(
             ranks, dg, e,
             n=n, damping=damping, dangling=dangling,
-            total_mass=total_mass, impl=impl,
+            total_mass=total_mass, impl=impl, pallas_meta=pallas_meta,
         )
 
     if cfg.tol > 0.0:

@@ -18,6 +18,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -52,26 +53,8 @@ def _gather_cumsum_kernel(src_ref, w_ref, out_ref, carry_ref):
     carry_ref[0, 0] = carry + jnp.sum(row_tot)
 
 
-@functools.partial(jax.jit, static_argnames=("n", "interpret"))
-def spmv_pallas(
-    src: jax.Array,
-    indptr: jax.Array,
-    w: jax.Array,
-    *,
-    n: int,
-    interpret: bool = False,
-) -> jax.Array:
-    """``contribs[v] = Σ_{e: dst-sorted, dst[e]=v} w[src[e]]``.
-
-    Args:
-      src: int32 [E] edge sources in dst-sorted order.
-      indptr: int32 [N+1] CSR row pointers into the dst-sorted edge list.
-      w: f32 [N] per-node values (already divided by out-degree).
-      n: number of nodes (static).
-    """
-    e = src.shape[0]
-    if e == 0:
-        return jnp.zeros(n, w.dtype)
+def _gather_cumsum(src, w, n, e, interpret):
+    """Inclusive prefix sum over ``w[src]`` (padded to a chunk multiple)."""
     dtype = w.dtype
     e_pad = _round_up(e, _CHUNK)
     # Pad w by ≥1 slot of zeros and point padded edges at it: they then add
@@ -93,6 +76,138 @@ def spmv_pallas(
         scratch_shapes=[pltpu.SMEM((1, 1), dtype)],
         interpret=interpret,
     )(src_pad.reshape(1, e_pad), w_pad)
+    return c1.reshape(e_pad)
 
-    c = jnp.concatenate([jnp.zeros(1, dtype), c1.reshape(e_pad)[:e]])
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def spmv_pallas(
+    src: jax.Array,
+    indptr: jax.Array,
+    w: jax.Array,
+    *,
+    n: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """``contribs[v] = Σ_{e: dst-sorted, dst[e]=v} w[src[e]]``.
+
+    Args:
+      src: int32 [E] edge sources in dst-sorted order.
+      indptr: int32 [N+1] CSR row pointers into the dst-sorted edge list.
+      w: f32 [N] per-node values (already divided by out-degree).
+      n: number of nodes (static).
+    """
+    e = src.shape[0]
+    if e == 0:
+        return jnp.zeros(n, w.dtype)
+    dtype = w.dtype
+    c1 = _gather_cumsum(src, w, n, e, interpret)
+    c = jnp.concatenate([jnp.zeros(1, dtype), c1[:e]])
     return c[indptr[1:]] - c[indptr[:-1]]
+
+
+# ---------------------------------------------------------------------------
+# Full-Pallas variant: the CSR-row difference also runs on-chip.
+# ---------------------------------------------------------------------------
+
+# Nodes per diff-kernel grid step.
+_NODE_CHUNK = 8 * 1024
+
+
+def _window_diff_kernel(starts_ref, lo_ref, hi_ref, c_hbm, out_ref, scratch, sem):
+    """One node chunk: DMA the contiguous cumsum window this chunk's CSR
+    rows span, then take per-row differences with chunk-local indices."""
+    i = pl.program_id(0)
+    start = starts_ref[i]
+    cap = scratch.shape[-1]
+    dma = pltpu.make_async_copy(
+        c_hbm.at[0, pl.ds(start, cap)], scratch.at[0], sem
+    )
+    dma.start()
+    dma.wait()
+    lo = lo_ref[:] - start
+    hi = hi_ref[:] - start
+    win = scratch[0]
+    out_ref[:] = (
+        jnp.take(win, hi.reshape(-1), axis=0) - jnp.take(win, lo.reshape(-1), axis=0)
+    ).reshape(out_ref.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "cap", "interpret"))
+def _window_diff(c, lo, hi, starts, *, n, cap, interpret):
+    n_pad = lo.shape[0]
+    grid = n_pad // _NODE_CHUNK
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, _NODE_CHUNK), lambda i, s: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _NODE_CHUNK), lambda i, s: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),  # cumsum stays in HBM
+        ],
+        out_specs=pl.BlockSpec(
+            (1, _NODE_CHUNK), lambda i, s: (0, i), memory_space=pltpu.VMEM
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((1, cap), c.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    out = pl.pallas_call(
+        _window_diff_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((1, n_pad), c.dtype),
+        interpret=interpret,
+    )(starts, lo.reshape(1, n_pad), hi.reshape(1, n_pad), c.reshape(1, -1))
+    return out.reshape(n_pad)[:n]
+
+
+def spmv_pallas_full(
+    src: jax.Array,
+    indptr: jax.Array,
+    w: jax.Array,
+    *,
+    n: int,
+    window_starts: jax.Array,
+    window_cap: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Like :func:`spmv_pallas` but the CSR-row difference is a second Pallas
+    kernel (per-node-chunk windowed DMA + on-chip take) instead of two XLA
+    gathers.  Needs host-precomputed window metadata from
+    :func:`diff_window_meta` (static per graph)."""
+    e = src.shape[0]
+    if e == 0:
+        return jnp.zeros(n, w.dtype)
+    c1 = _gather_cumsum(src, w, n, e, interpret)
+    # exclusive prefix c[j] = sum of first j per-edge values, padded so every
+    # window [start, start+cap) is in bounds
+    e_pad1 = _round_up(e + 1 + window_cap, _LANES)
+    c = jnp.zeros(e_pad1, w.dtype).at[1 : e + 1].set(c1[:e])
+    c = jnp.where(  # positions past e hold the total (diffs there are 0)
+        jnp.arange(e_pad1) > e, c1[e - 1] if e > 0 else 0.0, c
+    )
+    n_pad = _round_up(n, _NODE_CHUNK)
+    lo = jnp.full(n_pad, e, jnp.int32).at[:n].set(indptr[:-1].astype(jnp.int32))
+    hi = jnp.full(n_pad, e, jnp.int32).at[:n].set(indptr[1:].astype(jnp.int32))
+    return _window_diff(c, lo, hi, window_starts, n=n, cap=window_cap,
+                        interpret=interpret)
+
+
+def diff_window_meta(indptr: np.ndarray, n_edges: int) -> tuple[np.ndarray, int]:
+    """Per-node-chunk cumsum-window starts and the uniform window size.
+
+    Chunk i's CSR rows reference cumsum positions
+    ``[indptr[i*NC], indptr[min((i+1)*NC, n)]]`` — contiguous because the
+    edge array is dst-sorted.  Returns (starts int32 [grid], cap) with cap
+    the max span rounded up to lanes (the VMEM scratch size; caller should
+    fall back to the XLA diff when cap is too large for VMEM).
+    """
+    n = indptr.shape[0] - 1
+    n_pad = _round_up(n, _NODE_CHUNK)
+    grid = n_pad // _NODE_CHUNK
+    bounds = np.minimum(np.arange(grid + 1) * _NODE_CHUNK, n)
+    lo = indptr[bounds[:-1]]
+    hi = indptr[bounds[1:]]
+    span = int((hi + 1 - lo).max()) if grid > 0 else 1
+    cap = _round_up(max(span, _LANES), _LANES)
+    return lo.astype(np.int32), cap

@@ -86,7 +86,7 @@ class ShardedGraph(NamedTuple):
     inv_outdeg: np.ndarray  # f [n_pad]
     dangling: np.ndarray  # f [n_pad] (padding rows are NOT dangling: 0)
     pad_frac: float  # fraction of padded edge slots (load-imbalance gauge)
-    node_map: np.ndarray = None  # int64 [n]: global node id → padded slot
+    node_map: np.ndarray  # int64 [n]: global node id → padded slot
     # (identity-into-prefix for 'edges'/'nodes'; a relabeling under
     # 'nodes_balanced' where device blocks have unequal node counts)
 
@@ -146,7 +146,7 @@ def partition_graph(
         # 'nodes' layout while keeping edges near-balanced whenever the
         # degree distribution allows.
         cap = 2 * max(1, math.ceil(n / d))
-        indptr = np.searchsorted(graph.dst, np.arange(n + 1))
+        indptr = graph.csr_indptr()
         bounds_nodes = np.zeros(d + 1, np.int64)
         for i in range(1, d):
             target = int(np.searchsorted(indptr, (i * e) // d, side="left"))

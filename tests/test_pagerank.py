@@ -105,7 +105,7 @@ def test_tolerance_early_stop():
     assert res.l1_delta <= 1e-10
 
 
-@pytest.mark.parametrize("impl", ["bcoo", "cumsum", "pallas"])
+@pytest.mark.parametrize("impl", ["bcoo", "cumsum", "pallas", "pallas_full"])
 def test_spmv_impls_match_segment(impl):
     g = synthetic_powerlaw(100, 400, seed=7)
     r1 = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
@@ -164,6 +164,34 @@ def test_zero_iterations():
 def test_spark_exact_rejects_prefix_sum_impls(impl):
     with pytest.raises(ValueError, match="spark_exact requires"):
         PageRankConfig(spark_exact=True, dangling="drop", spmv_impl=impl)
+
+
+def test_pallas_full_multi_window(monkeypatch):
+    """The windowed-diff kernel must DMA the right cumsum window per node
+    chunk; shrink both chunk sizes so several windows are exercised."""
+    import jax.numpy as jnp
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_CHUNK", 1024)
+    monkeypatch.setattr(pk, "_NODE_CHUNK", 256)
+    pk.spmv_pallas.clear_cache()
+    pk._window_diff.clear_cache()
+    try:
+        g = synthetic_powerlaw(900, 6000, seed=5)
+        dg = ops.put_graph(g, "float64")
+        starts, cap = ops.pallas_full_meta(g)
+        assert starts.shape[0] > 3  # several windows
+        w = jnp.asarray(np.random.default_rng(4).random(g.n_nodes))
+        ref = ops.spmv_segment(dg, w, g.n_nodes)
+        got = pk.spmv_pallas_full(dg.src, dg.indptr, w, n=g.n_nodes,
+                                  window_starts=starts, window_cap=cap,
+                                  interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-9)
+    finally:
+        pk.spmv_pallas.clear_cache()
+        pk._window_diff.clear_cache()
 
 
 def test_pallas_spmv_multi_chunk_carry(monkeypatch):
