@@ -43,7 +43,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--personalize", type=int, nargs="+", default=None,
                    metavar="NODE", help="personalized PageRank source node(s)")
     p.add_argument("--spmv-impl",
-                   choices=["segment", "bcoo", "cumsum", "pallas", "pallas_full"],
+                   choices=["segment", "bcoo", "cumsum", "pallas"],
                    default="segment")
     p.add_argument("--dtype", default="float32")
     p.add_argument("--checkpoint-dir", default=None)

@@ -56,12 +56,7 @@ def run_pagerank(
     start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks, n=n) if resume else 0
     ranks_dev = jax.device_put(ranks.astype(cfg.dtype))
 
-    if cfg.spark_exact:
-        make = ops.make_spark_exact_runner
-    else:
-        meta = (ops.pallas_full_meta(graph, cfg.dtype)
-                if cfg.spmv_impl == "pallas_full" else None)
-        make = lambda n_, cfg_: ops.make_pagerank_runner(n_, cfg_, pallas_meta=meta)
+    make = ops.make_spark_exact_runner if cfg.spark_exact else ops.make_pagerank_runner
 
     def invoke(runner, rd):
         rd, iters, delta = runner(dg, rd, e)

@@ -118,69 +118,47 @@ def spmv_bcoo(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
     return mat @ weighted_ranks
 
 
-def spmv_cumsum(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
-    """Same contraction via prefix-sum differences: ``contribs[v] =
-    cumsum(per_edge)[indptr[v+1]] - cumsum(per_edge)[indptr[v]]``.
+def cumsum_diff_spmv(src, indptr, weighted, cumsum_fn) -> jax.Array:
+    """Shared prefix-sum SpMV skeleton: ``contribs[v] =
+    cumsum(weighted[src])[indptr[v+1]] - cumsum(...)[indptr[v]]``, exploiting
+    the dst-sorted edge invariant to replace the scatter-add with a cumsum
+    plus two *monotone* gathers.  ``cumsum_fn`` is the prefix-sum primitive
+    (``jnp.cumsum`` for the XLA variant, the Pallas carry kernel for
+    spmv_impl='pallas'); accuracy analysis on :func:`spmv_cumsum`."""
+    per_edge = weighted[src]
+    c0 = jnp.concatenate([jnp.zeros(1, per_edge.dtype), cumsum_fn(per_edge)])
+    return c0[indptr[1:]] - c0[indptr[:-1]]
 
-    Exploits the dst-sorted edge invariant to replace the scatter-add with a
-    cumsum plus two *monotone* gathers — measured 1.5x faster per PageRank
-    iteration than ``segment_sum`` at web-Google scale on TPU v5e, where
-    XLA's scatter path is the bottleneck.  Accuracy cost in float32: the
-    prefix sum accumulates to the full vector mass before differencing, so
-    per-SpMV L1 error is ~2e-4 relative (vs ~1e-5 for segment_sum); parity
-    tests run it in float64 where both are exact to 1e-12.
+
+def spmv_cumsum(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
+    """Prefix-sum SpMV through ``jnp.cumsum`` — measured 1.5x faster per
+    PageRank iteration than ``segment_sum`` at web-Google scale on TPU v5e,
+    where XLA's scatter path is the bottleneck.  Accuracy cost in float32:
+    the prefix sum accumulates to the full vector mass before differencing,
+    so per-SpMV L1 error is ~2e-4 relative (vs ~1e-5 for segment_sum);
+    parity tests run it in float64 where both are exact to 1e-12.
     """
     if dg.indptr is None:
         raise ValueError("spmv_impl='cumsum' needs DeviceGraph.indptr (use put_graph)")
-    per_edge = weighted_ranks[dg.src]
-    c0 = jnp.concatenate([jnp.zeros(1, per_edge.dtype), jnp.cumsum(per_edge)])
-    return c0[dg.indptr[1:]] - c0[dg.indptr[:-1]]
+    return cumsum_diff_spmv(dg.src, dg.indptr, weighted_ranks, jnp.cumsum)
 
 
-def pallas_full_meta(graph: Graph, dtype: str = "float32"):
-    """Host-side static metadata for spmv_impl='pallas_full': per-node-chunk
-    cumsum-window starts + uniform window size (see pallas_kernels).  Raises
-    when a window would blow the VMEM scratch budget — use 'pallas' then."""
-    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
-
-    starts, cap = pk.diff_window_meta(graph.csr_indptr(), graph.n_edges)
-    if cap * np.dtype(dtype).itemsize > 8 * 1024 * 1024:  # v5e VMEM scratch budget
-        raise ValueError(
-            f"pallas_full window cap {cap} x {dtype} exceeds the 8 MB VMEM "
-            "scratch budget (dense hub rows); use spmv_impl='pallas'"
-        )
-    return jnp.asarray(starts), cap
-
-
-def _spmv(
-    dg: DeviceGraph, weighted: jax.Array, n: int, impl: str, pallas_meta=None
-) -> jax.Array:
+def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
     if impl == "segment":
         return spmv_segment(dg, weighted, n)
     if impl == "bcoo":
         return spmv_bcoo(dg, weighted, n)
     if impl == "cumsum":
         return spmv_cumsum(dg, weighted, n)
-    if impl in ("pallas", "pallas_full"):
+    if impl == "pallas":
         from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
 
         if dg.indptr is None:
-            raise ValueError(f"spmv_impl={impl!r} needs DeviceGraph.indptr (use put_graph)")
+            raise ValueError("spmv_impl='pallas' needs DeviceGraph.indptr (use put_graph)")
         # Mosaic only compiles on real TPUs; everywhere else (CPU tests,
         # simulated meshes) run the same kernel under the interpreter.
         interpret = jax.default_backend() not in ("tpu", "axon")
-        if impl == "pallas":
-            return pk.spmv_pallas(dg.src, dg.indptr, weighted, n=n, interpret=interpret)
-        if pallas_meta is None:
-            raise ValueError(
-                "spmv_impl='pallas_full' needs window metadata; pass "
-                "pallas_meta=ops.pallas_full_meta(graph) to the runner"
-            )
-        starts, cap = pallas_meta
-        return pk.spmv_pallas_full(
-            dg.src, dg.indptr, weighted, n=n,
-            window_starts=starts, window_cap=cap, interpret=interpret,
-        )
+        return pk.spmv_pallas(dg.src, dg.indptr, weighted, n=n, interpret=interpret)
     raise ValueError(f"unknown spmv impl {impl!r}")
 
 
@@ -194,7 +172,6 @@ def pagerank_step(
     dangling: DanglingMode,
     total_mass: float,
     impl: str = "segment",
-    pallas_meta=None,
 ) -> jax.Array:
     """One power-iteration step.
 
@@ -207,7 +184,7 @@ def pagerank_step(
     preserved every step.
     """
     weighted = ranks * dg.inv_outdeg
-    contribs = _spmv(dg, weighted, n, impl, pallas_meta)
+    contribs = _spmv(dg, weighted, n, impl)
     if dangling is DanglingMode.REDISTRIBUTE:
         # lost mass re-enters through the restart distribution e; on a
         # sharded mesh this sum is the lax.psum of BASELINE.json:5.
@@ -238,7 +215,7 @@ def spark_exact_step(
     return SparkExactState(ranks=ranks, present=present)
 
 
-def make_pagerank_runner(n: int, cfg: PageRankConfig, *, pallas_meta=None):
+def make_pagerank_runner(n: int, cfg: PageRankConfig):
     """Compile the full iteration loop into one XLA program.
 
     Returns ``run(dg, ranks0, e) -> (ranks, iters_done, final_delta)``.
@@ -246,8 +223,7 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig, *, pallas_meta=None):
     reuses it); tolerance runs use ``lax.while_loop`` carrying the L1 delta.
     The Python-side driver loop of the reference (SURVEY.md §3.1 🔥 outer
     loop) disappears entirely — there are no host round-trips between
-    iterations.  ``pallas_meta`` (from :func:`pallas_full_meta`) is required
-    for spmv_impl='pallas_full'.
+    iterations.
     """
     damping = cfg.damping
     impl = cfg.spmv_impl
@@ -258,7 +234,7 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig, *, pallas_meta=None):
         return pagerank_step(
             ranks, dg, e,
             n=n, damping=damping, dangling=dangling,
-            total_mass=total_mass, impl=impl, pallas_meta=pallas_meta,
+            total_mass=total_mass, impl=impl,
         )
 
     if cfg.tol > 0.0:

@@ -2,14 +2,19 @@
 
 The per-iteration contraction ``contribs[v] = Σ_{(u,v)∈E} w[u]`` (the
 reference's ``flatMap(computeContribs).reduceByKey(add)`` chain,
-SURVEY.md §3.1) is a gather + segmented reduction over dst-sorted edges.
-``spmv_pallas`` fuses the two memory-bound passes XLA emits for the cumsum
-formulation (gather → HBM → cumsum) into one kernel: the rank table stays
-resident in VMEM (~3.4 MB at web-Google scale, well under the v5e budget),
-edge-source indices stream through in chunks, and each chunk is gathered
-and prefix-summed on-chip with a scalar carry across the sequential grid.
-The host-side wrapper then takes the O(N) monotone difference at the CSR
-row pointers, exactly like ``ops.pagerank.spmv_cumsum``.
+SURVEY.md §3.1) is, over dst-sorted edges, a gather + prefix sum + CSR-row
+difference.  The gather and the monotone row-pointer difference stay in XLA
+(Mosaic's vector gather only supports same-shape lane gathers, so a global
+table gather cannot beat XLA's own lowering on-chip).  What Pallas *can*
+win is the prefix sum: XLA lowers a multi-million-element 1-D cumsum as
+O(log E) shifted-add passes — each a full HBM sweep — while a sequential
+grid with a scalar carry does it in exactly one read and one write of the
+edge array.  ``cumsum_pallas`` is that kernel; ``spmv_pallas`` composes it
+with the XLA gather/diff into the ``spmv_impl='pallas'`` variant raced by
+bench.py.
+
+Lowering is validated without a chip via ``jax.export`` cross-platform
+lowering (tests/test_pagerank.py::test_pallas_kernel_lowers_for_tpu).
 """
 
 from __future__ import annotations
@@ -22,9 +27,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Edges streamed per grid step. 64K edges = 256 KB of int32 indices plus a
-# 256 KB f32 value block in VMEM — small next to the resident rank table.
-_CHUNK = 64 * 1024
+# Elements per grid step. 256K f32 = 1 MB in / 1 MB out per step in VMEM.
+_CHUNK = 256 * 1024
 _LANES = 128
 
 
@@ -32,8 +36,22 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
-def _gather_cumsum_kernel(src_ref, w_ref, out_ref, carry_ref):
-    """One edge chunk: gather w[src], inclusive prefix sum + running carry."""
+def _scan_axis(x, axis):
+    """Inclusive Hillis–Steele prefix sum along ``axis`` of a 2-D block,
+    built from Mosaic-supported primitives only (roll + iota mask + add;
+    ``jnp.cumsum`` has no Pallas TPU lowering)."""
+    idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    size = x.shape[axis]
+    shift = 1
+    while shift < size:
+        rolled = pltpu.roll(x, shift=np.int32(shift), axis=axis)
+        x = x + jnp.where(idx >= shift, rolled, jnp.zeros((), x.dtype))
+        shift *= 2
+    return x
+
+
+def _cumsum_carry_kernel(x_ref, out_ref, carry_ref):
+    """One chunk of a running prefix sum: 2-D local scan + scalar carry."""
     step = pl.program_id(0)
 
     @pl.when(step == 0)
@@ -41,45 +59,44 @@ def _gather_cumsum_kernel(src_ref, w_ref, out_ref, carry_ref):
         carry_ref[0, 0] = jnp.zeros((), carry_ref.dtype)
 
     rows = _CHUNK // _LANES
-    vals = jnp.take(w_ref[:], src_ref[:].reshape(-1), axis=0)
-    vals = vals.reshape(rows, _LANES)
-    # 2-D prefix sum in row-major edge order: lane-wise cumsum, then add the
-    # exclusive cumsum of the row totals.
-    lane_cum = jnp.cumsum(vals, axis=1)
-    row_tot = lane_cum[:, -1:]
-    row_base = jnp.cumsum(row_tot, axis=0) - row_tot
+    vals = x_ref[:].reshape(rows, _LANES)
+    # Row-major 2-D prefix sum: lane-wise scan, then add the exclusive
+    # scan of the row totals (computed lane-broadcast so both scans use the
+    # same (rows, 128) layout).
+    lane_cum = _scan_axis(vals, 1)
+    row_tot = jnp.broadcast_to(lane_cum[:, _LANES - 1 :], vals.shape)
+    row_cum = _scan_axis(row_tot, 0)
     carry = carry_ref[0, 0]
-    out_ref[:] = (lane_cum + row_base + carry).reshape(1, _CHUNK)
-    carry_ref[0, 0] = carry + jnp.sum(row_tot)
+    out_ref[:] = (lane_cum + (row_cum - row_tot) + carry).reshape(1, _CHUNK)
+    carry_ref[0, 0] = carry + row_cum[rows - 1, _LANES - 1]
 
 
-def _gather_cumsum(src, w, n, e, interpret):
-    """Inclusive prefix sum over ``w[src]`` (padded to a chunk multiple)."""
-    dtype = w.dtype
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def cumsum_pallas(x: jax.Array, *, interpret: bool = False) -> jax.Array:
+    """Inclusive 1-D prefix sum in one HBM read + one write.
+
+    The grid is sequential on TPU, so a scalar SMEM carry threads the
+    running total across chunks.
+    """
+    (e,) = x.shape
+    if e == 0:
+        return x
+    dtype = x.dtype
     e_pad = _round_up(e, _CHUNK)
-    # Pad w by ≥1 slot of zeros and point padded edges at it: they then add
-    # nothing to the prefix sum past position E.
-    n_pad = _round_up(n + 1, _LANES * 8)
-    w_pad = jnp.zeros(n_pad, dtype).at[:n].set(w)
-    src_pad = jnp.full(e_pad, n, jnp.int32).at[:e].set(src.astype(jnp.int32))
+    x_pad = jnp.zeros(e_pad, dtype).at[:e].set(x)
 
-    grid = e_pad // _CHUNK
-    c1 = pl.pallas_call(
-        _gather_cumsum_kernel,
-        grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((1, _CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),  # whole w table resident
-        ],
+    out = pl.pallas_call(
+        _cumsum_carry_kernel,
+        grid=(e_pad // _CHUNK,),
+        in_specs=[pl.BlockSpec((1, _CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((1, _CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((1, e_pad), dtype),
         scratch_shapes=[pltpu.SMEM((1, 1), dtype)],
         interpret=interpret,
-    )(src_pad.reshape(1, e_pad), w_pad)
-    return c1.reshape(e_pad)
+    )(x_pad.reshape(1, e_pad))
+    return out.reshape(e_pad)[:e]
 
 
-@functools.partial(jax.jit, static_argnames=("n", "interpret"))
 def spmv_pallas(
     src: jax.Array,
     indptr: jax.Array,
@@ -88,126 +105,23 @@ def spmv_pallas(
     n: int,
     interpret: bool = False,
 ) -> jax.Array:
-    """``contribs[v] = Σ_{e: dst-sorted, dst[e]=v} w[src[e]]``.
+    """``contribs[v] = Σ_{e: dst-sorted, dst[e]=v} w[src[e]]`` with the
+    prefix sum fused into :func:`cumsum_pallas` (gather and CSR-row
+    difference in XLA).
 
     Args:
       src: int32 [E] edge sources in dst-sorted order.
       indptr: int32 [N+1] CSR row pointers into the dst-sorted edge list.
-      w: f32 [N] per-node values (already divided by out-degree).
+      w: f[N] per-node values (already divided by out-degree).
       n: number of nodes (static).
     """
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops.pagerank import (
+        cumsum_diff_spmv,
+    )
+
     e = src.shape[0]
     if e == 0:
         return jnp.zeros(n, w.dtype)
-    dtype = w.dtype
-    c1 = _gather_cumsum(src, w, n, e, interpret)
-    c = jnp.concatenate([jnp.zeros(1, dtype), c1[:e]])
-    return c[indptr[1:]] - c[indptr[:-1]]
-
-
-# ---------------------------------------------------------------------------
-# Full-Pallas variant: the CSR-row difference also runs on-chip.
-# ---------------------------------------------------------------------------
-
-# Nodes per diff-kernel grid step.
-_NODE_CHUNK = 8 * 1024
-
-
-def _window_diff_kernel(starts_ref, lo_ref, hi_ref, c_hbm, out_ref, scratch, sem):
-    """One node chunk: DMA the contiguous cumsum window this chunk's CSR
-    rows span, then take per-row differences with chunk-local indices."""
-    i = pl.program_id(0)
-    start = starts_ref[i]
-    cap = scratch.shape[-1]
-    dma = pltpu.make_async_copy(
-        c_hbm.at[0, pl.ds(start, cap)], scratch.at[0], sem
+    return cumsum_diff_spmv(
+        src, indptr, w, functools.partial(cumsum_pallas, interpret=interpret)
     )
-    dma.start()
-    dma.wait()
-    lo = lo_ref[:] - start
-    hi = hi_ref[:] - start
-    win = scratch[0]
-    out_ref[:] = (
-        jnp.take(win, hi.reshape(-1), axis=0) - jnp.take(win, lo.reshape(-1), axis=0)
-    ).reshape(out_ref.shape)
-
-
-@functools.partial(jax.jit, static_argnames=("n", "cap", "interpret"))
-def _window_diff(c, lo, hi, starts, *, n, cap, interpret):
-    n_pad = lo.shape[0]
-    grid = n_pad // _NODE_CHUNK
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(grid,),
-        in_specs=[
-            pl.BlockSpec((1, _NODE_CHUNK), lambda i, s: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, _NODE_CHUNK), lambda i, s: (0, i), memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),  # cumsum stays in HBM
-        ],
-        out_specs=pl.BlockSpec(
-            (1, _NODE_CHUNK), lambda i, s: (0, i), memory_space=pltpu.VMEM
-        ),
-        scratch_shapes=[
-            pltpu.VMEM((1, cap), c.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
-    )
-    out = pl.pallas_call(
-        _window_diff_kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((1, n_pad), c.dtype),
-        interpret=interpret,
-    )(starts, lo.reshape(1, n_pad), hi.reshape(1, n_pad), c.reshape(1, -1))
-    return out.reshape(n_pad)[:n]
-
-
-def spmv_pallas_full(
-    src: jax.Array,
-    indptr: jax.Array,
-    w: jax.Array,
-    *,
-    n: int,
-    window_starts: jax.Array,
-    window_cap: int,
-    interpret: bool = False,
-) -> jax.Array:
-    """Like :func:`spmv_pallas` but the CSR-row difference is a second Pallas
-    kernel (per-node-chunk windowed DMA + on-chip take) instead of two XLA
-    gathers.  Needs host-precomputed window metadata from
-    :func:`diff_window_meta` (static per graph)."""
-    e = src.shape[0]
-    if e == 0:
-        return jnp.zeros(n, w.dtype)
-    c1 = _gather_cumsum(src, w, n, e, interpret)
-    # exclusive prefix c[j] = sum of first j per-edge values, padded so every
-    # window [start, start+cap) is in bounds
-    e_pad1 = _round_up(e + 1 + window_cap, _LANES)
-    c = jnp.zeros(e_pad1, w.dtype).at[1 : e + 1].set(c1[:e])
-    c = jnp.where(  # positions past e hold the total (diffs there are 0)
-        jnp.arange(e_pad1) > e, c1[e - 1] if e > 0 else 0.0, c
-    )
-    n_pad = _round_up(n, _NODE_CHUNK)
-    lo = jnp.full(n_pad, e, jnp.int32).at[:n].set(indptr[:-1].astype(jnp.int32))
-    hi = jnp.full(n_pad, e, jnp.int32).at[:n].set(indptr[1:].astype(jnp.int32))
-    return _window_diff(c, lo, hi, window_starts, n=n, cap=window_cap,
-                        interpret=interpret)
-
-
-def diff_window_meta(indptr: np.ndarray, n_edges: int) -> tuple[np.ndarray, int]:
-    """Per-node-chunk cumsum-window starts and the uniform window size.
-
-    Chunk i's CSR rows reference cumsum positions
-    ``[indptr[i*NC], indptr[min((i+1)*NC, n)]]`` — contiguous because the
-    edge array is dst-sorted.  Returns (starts int32 [grid], cap) with cap
-    the max span rounded up to lanes (the VMEM scratch size; caller should
-    fall back to the XLA diff when cap is too large for VMEM).
-    """
-    n = indptr.shape[0] - 1
-    n_pad = _round_up(n, _NODE_CHUNK)
-    grid = n_pad // _NODE_CHUNK
-    bounds = np.minimum(np.arange(grid + 1) * _NODE_CHUNK, n)
-    lo = indptr[bounds[:-1]]
-    hi = indptr[bounds[1:]]
-    span = int((hi + 1 - lo).max()) if grid > 0 else 1
-    cap = _round_up(max(span, _LANES), _LANES)
-    return lo.astype(np.int32), cap

@@ -105,7 +105,7 @@ def test_tolerance_early_stop():
     assert res.l1_delta <= 1e-10
 
 
-@pytest.mark.parametrize("impl", ["bcoo", "cumsum", "pallas", "pallas_full"])
+@pytest.mark.parametrize("impl", ["bcoo", "cumsum", "pallas"])
 def test_spmv_impls_match_segment(impl):
     g = synthetic_powerlaw(100, 400, seed=7)
     r1 = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
@@ -166,35 +166,7 @@ def test_spark_exact_rejects_prefix_sum_impls(impl):
         PageRankConfig(spark_exact=True, dangling="drop", spmv_impl=impl)
 
 
-def test_pallas_full_multi_window(monkeypatch):
-    """The windowed-diff kernel must DMA the right cumsum window per node
-    chunk; shrink both chunk sizes so several windows are exercised."""
-    import jax.numpy as jnp
-
-    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
-    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
-
-    monkeypatch.setattr(pk, "_CHUNK", 1024)
-    monkeypatch.setattr(pk, "_NODE_CHUNK", 256)
-    pk.spmv_pallas.clear_cache()
-    pk._window_diff.clear_cache()
-    try:
-        g = synthetic_powerlaw(900, 6000, seed=5)
-        dg = ops.put_graph(g, "float64")
-        starts, cap = ops.pallas_full_meta(g)
-        assert starts.shape[0] > 3  # several windows
-        w = jnp.asarray(np.random.default_rng(4).random(g.n_nodes))
-        ref = ops.spmv_segment(dg, w, g.n_nodes)
-        got = pk.spmv_pallas_full(dg.src, dg.indptr, w, n=g.n_nodes,
-                                  window_starts=starts, window_cap=cap,
-                                  interpret=True)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-9)
-    finally:
-        pk.spmv_pallas.clear_cache()
-        pk._window_diff.clear_cache()
-
-
-def test_pallas_spmv_multi_chunk_carry(monkeypatch):
+def test_pallas_cumsum_multi_chunk_carry(monkeypatch):
     """The Pallas kernel's scalar carry must thread the prefix sum across
     grid steps; shrink the chunk so a modest graph spans several chunks."""
     import jax.numpy as jnp
@@ -203,7 +175,7 @@ def test_pallas_spmv_multi_chunk_carry(monkeypatch):
     from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
 
     monkeypatch.setattr(pk, "_CHUNK", 1024)
-    pk.spmv_pallas.clear_cache()
+    pk.cumsum_pallas.clear_cache()
     try:
         g = synthetic_powerlaw(800, 5000, seed=11)
         dg = ops.put_graph(g, "float64")
@@ -213,4 +185,25 @@ def test_pallas_spmv_multi_chunk_carry(monkeypatch):
         assert int(np.ceil(g.n_edges / 1024)) > 3  # really multi-chunk
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-9)
     finally:
-        pk.spmv_pallas.clear_cache()
+        pk.cumsum_pallas.clear_cache()
+
+
+def test_pallas_kernel_lowers_for_tpu():
+    """Pin Mosaic lowering without a chip: jax.export cross-platform
+    lowering runs the Pallas→Mosaic pipeline and rejects unsupported ops
+    (this is what caught the original in-kernel gather design)."""
+    import jax
+    from jax import export
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
+
+    g = synthetic_powerlaw(5000, 40000, seed=1)
+    dg = ops.put_graph(g, "float32")
+    import jax.numpy as jnp
+
+    w = jnp.zeros(g.n_nodes, jnp.float32)
+    fn = jax.jit(lambda src, ip, w: pk.spmv_pallas(src, ip, w, n=g.n_nodes,
+                                                   interpret=False))
+    exp = export.export(fn, platforms=["tpu"])(dg.src, dg.indptr, w)
+    assert "tpu_custom_call" in exp.mlir_module()
