@@ -140,9 +140,12 @@ def main() -> int:
 
     # --- accelerator: race candidates, each isolated in a subprocess ---
     candidates = os.environ.get("BENCH_IMPLS", "cumsum,pallas,segment").split(",")
+    import atexit
     import tempfile
 
-    graph_cache = os.path.join(tempfile.gettempdir(), "bench_graph.npz")
+    fd, graph_cache = tempfile.mkstemp(prefix="bench_graph_", suffix=".npz")
+    os.close(fd)
+    atexit.register(lambda: os.path.exists(graph_cache) and os.unlink(graph_cache))
     _save_graph(graph, graph_cache)
     child_env = dict(os.environ, BENCH_GRAPH_NPZ=graph_cache)
     results: dict[str, float] = {}

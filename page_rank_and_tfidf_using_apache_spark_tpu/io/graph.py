@@ -99,16 +99,27 @@ def from_edges(
 
     src = src.astype(np.int64)
     dst = dst.astype(np.int64)
-    # lexsort (dst major, src minor) gives both the dedup order and the
-    # final destination-sorted layout; unlike a dst*n+src composite key it
-    # cannot overflow for large raw ids under compact_ids=False.
-    order = np.lexsort((src, dst))
-    src, dst = src[order], dst[order]
-    if dedup and src.size:
-        keep = np.empty(src.shape, dtype=bool)
-        keep[0] = True
-        keep[1:] = (src[1:] != src[:-1]) | (dst[1:] != dst[:-1])
-        src, dst = src[keep], dst[keep]
+    # Sort (dst major, src minor) — both the dedup order and the final
+    # destination-sorted layout every SpMV impl relies on.  The native C++
+    # radix sort wins by several x at soc-LiveJournal1 scale; the numpy
+    # lexsort fallback is bit-identical (unlike a dst*n+src composite key,
+    # neither can overflow for large raw ids under compact_ids=False).
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils import native
+
+    sorted_pair = (
+        native.sort_dedup_edges(src, dst, dedup=dedup)
+        if src.size and n <= (1 << 31) else None
+    )
+    if sorted_pair is not None:
+        src, dst = sorted_pair
+    else:
+        order = np.lexsort((src, dst))
+        src, dst = src[order], dst[order]
+        if dedup and src.size:
+            keep = np.empty(src.shape, dtype=bool)
+            keep[0] = True
+            keep[1:] = (src[1:] != src[:-1]) | (dst[1:] != dst[:-1])
+            src, dst = src[keep], dst[keep]
 
     out_degree = np.bincount(src, minlength=n).astype(np.int32)
     return Graph(

@@ -14,7 +14,7 @@ with the XLA gather/diff into the ``spmv_impl='pallas'`` variant raced by
 bench.py.
 
 Lowering is validated without a chip via ``jax.export`` cross-platform
-lowering (tests/test_pagerank.py::test_pallas_kernel_lowers_for_tpu).
+lowering (tests/test_tpu_lowering.py).
 """
 
 from __future__ import annotations

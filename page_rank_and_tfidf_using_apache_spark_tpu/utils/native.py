@@ -54,7 +54,9 @@ def _load() -> ctypes.CDLL | None:
             lib = ctypes.CDLL(_LIB_PATH)
             _declare(lib)
             _lib = lib
-        except (OSError, subprocess.CalledProcessError):
+        except (OSError, subprocess.CalledProcessError, AttributeError):
+            # AttributeError: a stale prebuilt .so missing a newer symbol —
+            # fall back to numpy rather than crash every ingest call.
             _lib_failed = True
     return _lib
 
@@ -69,6 +71,9 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.parse_edges_count.restype = c_i64
     lib.parse_edges_fill.argtypes = [p_u8, c_i64, p_i64, p_i64]
     lib.parse_edges_fill.restype = c_i64
+
+    lib.sort_dedup_edges.argtypes = [p_i64, p_i64, c_i64, c_i64]
+    lib.sort_dedup_edges.restype = c_i64
 
     lib.tokenize_hash_count.argtypes = [p_u8, c_i64, p_i64, c_i64, c_i64, c_i64, c_i64]
     lib.tokenize_hash_count.restype = c_i64
@@ -106,6 +111,32 @@ def parse_edge_file(path: str) -> np.ndarray | None:
     if got != n:
         return None
     return np.stack([src, dst], axis=1)
+
+
+def sort_dedup_edges(
+    src: np.ndarray, dst: np.ndarray, *, dedup: bool = True
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """(dst, src)-radix-sort + optional dedup of compacted int64 edge arrays
+    in C++ (the graph-builder hot step); None if the native layer is
+    unavailable or ids exceed 2^31 (caller falls back to np.lexsort).
+
+    MUTATES ``src``/``dst`` in place when they are already contiguous int64
+    (the from_edges call site owns fresh astype copies; at soc-LiveJournal1
+    scale a defensive copy would be an extra ~1 GB).  On failure (-1) the
+    inputs are untouched — validation happens before any write."""
+    lib = _load()
+    if lib is None or src.size == 0:
+        return None
+    src_c = np.ascontiguousarray(src, dtype=np.int64)
+    dst_c = np.ascontiguousarray(dst, dtype=np.int64)
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    n = lib.sort_dedup_edges(
+        src_c.ctypes.data_as(p_i64), dst_c.ctypes.data_as(p_i64),
+        src_c.size, int(dedup),
+    )
+    if n < 0:
+        return None
+    return src_c[:n], dst_c[:n]
 
 
 def tokenize_and_hash(

@@ -151,3 +151,42 @@ def test_tokenize_corpus_native_equals_fallback(monkeypatch):
     np.testing.assert_array_equal(tc_native.doc_ids, tc_numpy.doc_ids)
     np.testing.assert_array_equal(tc_native.term_ids, tc_numpy.term_ids)
     np.testing.assert_array_equal(tc_native.doc_lengths, tc_numpy.doc_lengths)
+
+
+@pytest.mark.parametrize("dedup", [True, False])
+def test_sort_dedup_edges_matches_lexsort(dedup):
+    """The C++ radix sort must reproduce numpy's (dst, src) lexsort layout
+    bit-for-bit, including duplicate handling and self-loops."""
+    rng = np.random.default_rng(3)
+    src = rng.integers(0, 500, 20_000).astype(np.int64)
+    dst = rng.integers(0, 500, 20_000).astype(np.int64)
+    src[::97] = dst[::97]  # self-loops
+    src[1000:1100] = src[:100]  # guaranteed duplicates
+    dst[1000:1100] = dst[:100]
+
+    # the native call mutates its inputs in place — compare against copies
+    got = native.sort_dedup_edges(src.copy(), dst.copy(), dedup=dedup)
+    assert got is not None
+    order = np.lexsort((src, dst))
+    s, d = src[order], dst[order]
+    if dedup:
+        keep = np.empty(s.shape, bool)
+        keep[0] = True
+        keep[1:] = (s[1:] != s[:-1]) | (d[1:] != d[:-1])
+        s, d = s[keep], d[keep]
+    np.testing.assert_array_equal(got[0], s)
+    np.testing.assert_array_equal(got[1], d)
+
+
+def test_from_edges_native_equals_fallback(monkeypatch):
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import from_edges
+
+    rng = np.random.default_rng(9)
+    src = rng.integers(0, 2000, 50_000)
+    dst = rng.integers(0, 2000, 50_000)
+    g_native = from_edges(src, dst)
+    monkeypatch.setattr(native, "sort_dedup_edges", lambda *a, **k: None)
+    g_numpy = from_edges(src, dst)
+    np.testing.assert_array_equal(g_native.src, g_numpy.src)
+    np.testing.assert_array_equal(g_native.dst, g_numpy.dst)
+    np.testing.assert_array_equal(g_native.out_degree, g_numpy.out_degree)

@@ -188,22 +188,5 @@ def test_pallas_cumsum_multi_chunk_carry(monkeypatch):
         pk.cumsum_pallas.clear_cache()
 
 
-def test_pallas_kernel_lowers_for_tpu():
-    """Pin Mosaic lowering without a chip: jax.export cross-platform
-    lowering runs the Pallas→Mosaic pipeline and rejects unsupported ops
-    (this is what caught the original in-kernel gather design)."""
-    import jax
-    from jax import export
-
-    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
-    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
-
-    g = synthetic_powerlaw(5000, 40000, seed=1)
-    dg = ops.put_graph(g, "float32")
-    import jax.numpy as jnp
-
-    w = jnp.zeros(g.n_nodes, jnp.float32)
-    fn = jax.jit(lambda src, ip, w: pk.spmv_pallas(src, ip, w, n=g.n_nodes,
-                                                   interpret=False))
-    exp = export.export(fn, platforms=["tpu"])(dg.src, dg.indptr, w)
-    assert "tpu_custom_call" in exp.mlir_module()
+# TPU lowering pins (incl. the Mosaic pipeline for the Pallas kernel) live
+# in tests/test_tpu_lowering.py.
