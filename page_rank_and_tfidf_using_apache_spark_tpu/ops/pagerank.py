@@ -118,14 +118,13 @@ def spmv_bcoo(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
     return mat @ weighted_ranks
 
 
-def cumsum_diff_spmv(src, indptr, weighted, cumsum_fn) -> jax.Array:
-    """Shared prefix-sum SpMV skeleton: ``contribs[v] =
-    cumsum(weighted[src])[indptr[v+1]] - cumsum(...)[indptr[v]]``, exploiting
-    the dst-sorted edge invariant to replace the scatter-add with a cumsum
+def cumsum_diff_spmv(per_edge, indptr, cumsum_fn=jnp.cumsum) -> jax.Array:
+    """Shared prefix-sum segmented-reduction skeleton: ``out[v] =
+    cumsum(per_edge)[indptr[v+1]] - cumsum(per_edge)[indptr[v]]``, exploiting
+    a sorted-segment invariant to replace the scatter-add with a cumsum
     plus two *monotone* gathers.  ``cumsum_fn`` is the prefix-sum primitive
     (``jnp.cumsum`` for the XLA variant, the Pallas carry kernel for
     spmv_impl='pallas'); accuracy analysis on :func:`spmv_cumsum`."""
-    per_edge = weighted[src]
     c0 = jnp.concatenate([jnp.zeros(1, per_edge.dtype), cumsum_fn(per_edge)])
     return c0[indptr[1:]] - c0[indptr[:-1]]
 
@@ -140,7 +139,7 @@ def spmv_cumsum(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array
     """
     if dg.indptr is None:
         raise ValueError("spmv_impl='cumsum' needs DeviceGraph.indptr (use put_graph)")
-    return cumsum_diff_spmv(dg.src, dg.indptr, weighted_ranks, jnp.cumsum)
+    return cumsum_diff_spmv(weighted_ranks[dg.src], dg.indptr)
 
 
 def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:

@@ -123,5 +123,5 @@ def spmv_pallas(
     if e == 0:
         return jnp.zeros(n, w.dtype)
     return cumsum_diff_spmv(
-        src, indptr, w, functools.partial(cumsum_pallas, interpret=interpret)
+        w[src], indptr, functools.partial(cumsum_pallas, interpret=interpret)
     )

@@ -89,12 +89,26 @@ class ShardedGraph(NamedTuple):
     node_map: np.ndarray  # int64 [n]: global node id → padded slot
     # (identity-into-prefix for 'edges'/'nodes'; a relabeling under
     # 'nodes_balanced' where device blocks have unequal node counts)
+    local_indptr: np.ndarray  # int32 [D, S+1]: per-device CSR row
+    # pointers into that device's (sorted) edge slice, S = n_pad under
+    # 'edges' / block under node strategies — the monotone-diff pointers
+    # for spmv_impl='cumsum' (host memory cost D*S ints; sharded on device)
 
 
 def partition_graph(
-    graph: Graph, n_devices: int, *, strategy: str = "edges", dtype: str = "float32"
+    graph: Graph,
+    n_devices: int,
+    *,
+    strategy: str = "edges",
+    dtype: str = "float32",
+    need_local_indptr: bool = True,
 ) -> ShardedGraph:
-    """Partition once on host (the reference partitions on every shuffle)."""
+    """Partition once on host (the reference partitions on every shuffle).
+
+    ``need_local_indptr=False`` skips the per-device CSR pointer build —
+    only spmv_impl='cumsum' reads it, and under 'edges' it costs D
+    node-sized int32 arrays (a (D, 1) placeholder is stored instead so the
+    runner signature stays fixed)."""
     if strategy not in ("edges", "nodes", "nodes_balanced"):
         raise ValueError(f"unknown shard strategy {strategy!r}")
     d = n_devices
@@ -122,10 +136,17 @@ def partition_graph(
         inv[:n] = inv_g
         dangling = np.zeros(n_pad, dtype)
         dangling[:n] = dang_g
+        dst2 = dst.reshape(d, e_dev)
+        local_indptr = (
+            np.stack(
+                [np.searchsorted(dst2[i], np.arange(n_pad + 1)) for i in range(d)]
+            ).astype(np.int32)
+            if need_local_indptr else np.zeros((d, 1), np.int32)
+        )
         return ShardedGraph(strategy, n, n_pad, block,
-                            src.reshape(d, e_dev), dst.reshape(d, e_dev),
+                            src.reshape(d, e_dev), dst2,
                             valid.reshape(d, e_dev), inv, dangling, pad_frac,
-                            np.arange(n, dtype=np.int64))
+                            np.arange(n, dtype=np.int64), local_indptr)
 
     # Node-sharded strategies: device i owns global nodes [b_i, b_{i+1})
     # (their rank shard and their in-edges, which are contiguous in the
@@ -181,8 +202,14 @@ def partition_graph(
     inv[node_map] = inv_g
     dangling = np.zeros(n_pad, dtype)
     dangling[node_map] = dang_g
+    local_indptr = (
+        np.stack(
+            [np.searchsorted(dst_local[i], np.arange(block + 1)) for i in range(d)]
+        ).astype(np.int32)
+        if need_local_indptr else np.zeros((d, 1), np.int32)
+    )
     return ShardedGraph(strategy, n, n_pad, block, src, dst_local, valid,
-                        inv, dangling, pad_frac, node_map)
+                        inv, dangling, pad_frac, node_map, local_indptr)
 
 
 def _to_padded(sg: ShardedGraph, global_vec: np.ndarray, dtype: str) -> np.ndarray:
@@ -205,10 +232,10 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
         raise NotImplementedError(
             "spark_exact is a single-chip parity mode; run it without a mesh"
         )
-    if cfg.spmv_impl != "segment":
+    if cfg.spmv_impl not in ("segment", "cumsum"):
         raise NotImplementedError(
             f"spmv_impl={cfg.spmv_impl!r} is not wired into the sharded "
-            "runner yet; use 'segment' with --mesh"
+            "runner; use 'segment' or 'cumsum' with --mesh"
         )
     axis = mesh.axis_names[0]
     damping = cfg.damping
@@ -216,14 +243,22 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
     redistribute = cfg.dangling is DanglingMode.REDISTRIBUTE
     n_pad, block = sg.n_pad, sg.block
 
+    def local_reduce(per_edge, dst_row, ip_row, num_segments):
+        """Per-device `reduceByKey` over its sorted edge slice: the shared
+        scatter-free monotone-diff skeleton under 'cumsum', segment_sum
+        otherwise."""
+        if cfg.spmv_impl == "cumsum":
+            return ops.cumsum_diff_spmv(per_edge, ip_row)
+        return jax.ops.segment_sum(
+            per_edge, dst_row, num_segments=num_segments, indices_are_sorted=True
+        )
+
     if sg.strategy == "edges":
         # state: replicated full rank vector; one psum per iteration.
-        def step(ranks, src, dst, valid, inv, dang, e):
+        def step(ranks, src, dst, valid, ip, inv, dang, e):
             weighted = ranks * inv
             per_edge = weighted[src[0]] * valid[0]
-            partial = jax.ops.segment_sum(
-                per_edge, dst[0], num_segments=n_pad, indices_are_sorted=True
-            )
+            partial = local_reduce(per_edge, dst[0], ip[0], n_pad)
             contribs = coll.psum(partial, axis)  # the reduceByKey, on ICI
             if redistribute:
                 contribs = contribs + jnp.sum(ranks * dang) * e
@@ -237,12 +272,10 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
         # node-sharded (per-chip HBM holds only 1/D of every [n_pad] vector,
         # which is the whole point of this strategy); all_gather the
         # degree-weighted ranks, psum only the dangling-mass scalar.
-        def step(ranks_b, src, dst_local, valid, inv_b, dang_b, e_b):
+        def step(ranks_b, src, dst_local, valid, ip, inv_b, dang_b, e_b):
             weighted_full = coll.all_gather(ranks_b * inv_b, axis)
             per_edge = weighted_full[src[0]] * valid[0]
-            contrib_b = jax.ops.segment_sum(
-                per_edge, dst_local[0], num_segments=block, indices_are_sorted=True
-            )
+            contrib_b = local_reduce(per_edge, dst_local[0], ip[0], block)
             if redistribute:
                 dmass = coll.psum(jnp.sum(ranks_b * dang_b), axis)
                 contrib_b = contrib_b + dmass * e_b
@@ -252,7 +285,7 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
         vec_spec = P(axis)
         local_delta = lambda new, old: coll.psum(jnp.sum(jnp.abs(new - old)), axis)
 
-    def loop(ranks0, src, dst, valid, inv, dang, e):
+    def loop(ranks0, src, dst, valid, ip, inv, dang, e):
         if cfg.tol > 0.0:
             def cond(carry):
                 _, delta, it = carry
@@ -260,7 +293,7 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
 
             def body(carry):
                 ranks, _, it = carry
-                new = step(ranks, src, dst, valid, inv, dang, e)
+                new = step(ranks, src, dst, valid, ip, inv, dang, e)
                 return new, local_delta(new, ranks), it + 1
 
             init = (ranks0, jnp.array(jnp.inf, ranks0.dtype), jnp.array(0, jnp.int32))
@@ -268,7 +301,7 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
             return ranks, it, delta
 
         def body(ranks, _):
-            new = step(ranks, src, dst, valid, inv, dang, e)
+            new = step(ranks, src, dst, valid, ip, inv, dang, e)
             return new, local_delta(new, ranks)
 
         ranks, deltas = lax.scan(body, ranks0, None, length=cfg.iterations)
@@ -279,7 +312,8 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
     mapped = shard_map(
         loop,
         mesh=mesh,
-        in_specs=(state_spec, edge_spec, edge_spec, edge_spec, vec_spec, vec_spec, vec_spec),
+        in_specs=(state_spec, edge_spec, edge_spec, edge_spec, edge_spec,
+                  vec_spec, vec_spec, vec_spec),
         out_specs=(state_spec, P(), P()),
         check_vma=False,
     )
@@ -297,6 +331,7 @@ def device_put_sharded_graph(sg: ShardedGraph, mesh: Mesh):
         jax.device_put(sg.src, esh),
         jax.device_put(sg.dst, esh),
         jax.device_put(sg.valid, esh),
+        jax.device_put(sg.local_indptr, esh),
         jax.device_put(sg.inv_outdeg, vsh),
         jax.device_put(sg.dangling, vsh),
     )
@@ -324,7 +359,10 @@ def run_pagerank_sharded(
         return PageRankResult(np.zeros(0, cfg.dtype), 0, 0.0, metrics)
 
     with Timer() as t_part:
-        sg = partition_graph(graph, d, strategy=strategy, dtype=cfg.dtype)
+        sg = partition_graph(
+            graph, d, strategy=strategy, dtype=cfg.dtype,
+            need_local_indptr=cfg.spmv_impl == "cumsum",
+        )
         dev = device_put_sharded_graph(sg, mesh)
     metrics.record(
         event="partition", strategy=strategy, devices=d, block=sg.block,
@@ -342,7 +380,7 @@ def run_pagerank_sharded(
     ranks_dev = jax.device_put(_to_padded(sg, ranks_g, cfg.dtype), state_sharding)
 
     def invoke(runner, rd):
-        rd, iters, delta = runner(rd, *dev[:3], *dev[3:], e_vec)
+        rd, iters, delta = runner(rd, *dev, e_vec)
         delta = float(delta)  # scalar fetch is the only reliable device sync
         return rd, iters, delta
 

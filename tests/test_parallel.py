@@ -38,6 +38,16 @@ def test_chip_count_invariance(graph, single_chip_ranks, n_devices, strategy):
     assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9
 
 
+@pytest.mark.parametrize("strategy", ["edges", "nodes", "nodes_balanced"])
+def test_sharded_cumsum_impl_matches_single_chip(graph, single_chip_ranks, strategy):
+    """The scatter-free monotone-diff SpMV must agree with segment_sum in
+    every sharded layout (local_indptr correctness incl. padding slots)."""
+    cfg = PageRankConfig(iterations=30, dangling="redistribute", init="uniform",
+                         dtype="float64", spmv_impl="cumsum")
+    res = run_pagerank_sharded(graph, cfg, n_devices=8, strategy=strategy)
+    assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9
+
+
 def test_sharded_drop_and_one_init(graph):
     """Spark-convention flags work sharded too (init ONE, dangling drop)."""
     cfg = PageRankConfig(iterations=10, dtype="float64")

@@ -58,6 +58,32 @@ def test_pagerank_tolerance_runner_lowers_for_tpu(device_graph):
     assert export.export(runner, platforms=["tpu"])(dg, r0, e).mlir_module()
 
 
+@pytest.mark.parametrize("impl", ["segment", "cumsum"])
+@pytest.mark.parametrize("strategy", ["edges", "nodes", "nodes_balanced"])
+def test_sharded_runner_lowers_for_tpu(strategy, impl):
+    """The multi-chip shard_map program (collectives included) must lower
+    for the TPU platform — the CPU dryrun alone cannot prove that."""
+    import numpy as np
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import make_mesh
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+        pagerank_sharded as ps,
+    )
+
+    g = synthetic_powerlaw(2000, 10000, seed=1)
+    mesh = make_mesh(8)
+    cfg = PageRankConfig(iterations=3, dangling="redistribute", init="uniform",
+                         dtype="float32", spmv_impl=impl)
+    sg = ps.partition_graph(g, 8, strategy=strategy, dtype="float32")
+    runner = ps.make_sharded_runner(sg, cfg, mesh)
+    dev = ps.device_put_sharded_graph(sg, mesh)
+    e_vec = jnp.asarray(ps._restart_padded(sg, cfg))
+    r0 = jnp.asarray(ps._to_padded(sg, np.full(sg.n, 1.0 / sg.n, np.float32),
+                                   "float32"))
+    exp = export.export(runner, platforms=["tpu"])(r0, *dev, e_vec)
+    assert exp.mlir_module()
+
+
 def test_tfidf_passes_lower_for_tpu():
     ids = jnp.zeros(1024, jnp.int32)
     docs = jnp.zeros(1024, jnp.int32)
