@@ -139,7 +139,10 @@ def main() -> int:
     log(f"cpu anchor (scipy CSR): {cpu_ips:.2f} iters/sec")
 
     # --- accelerator: race candidates, each isolated in a subprocess ---
-    candidates = os.environ.get("BENCH_IMPLS", "cumsum,pallas,segment").split(",")
+    # Ordered safe-first: cumsum/segment are known to compile on-chip; the
+    # Pallas candidate runs LAST so a wedged Mosaic compile (killed at the
+    # timeout) can never block the measurements that already succeeded.
+    candidates = os.environ.get("BENCH_IMPLS", "cumsum,segment,pallas").split(",")
     import atexit
     import tempfile
 

@@ -84,6 +84,22 @@ def test_sharded_runner_lowers_for_tpu(strategy, impl):
     assert exp.mlir_module()
 
 
+def test_tfidf_sharded_kernel_lowers_for_tpu():
+    """The vocab-sharded TF-IDF ingest kernel (psum'd DF) must lower for
+    the TPU platform."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import make_mesh
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel.tfidf_sharded import (
+        make_sharded_counts_kernel,
+    )
+
+    mesh = make_mesh(8)
+    kernel = make_sharded_counts_kernel(mesh, vocab=4096)
+    docs = jnp.zeros((8, 256), jnp.int32)
+    terms = jnp.zeros((8, 256), jnp.int32)
+    valid = jnp.ones((8, 256), bool)
+    assert export.export(kernel, platforms=["tpu"])(docs, terms, valid).mlir_module()
+
+
 def test_tfidf_passes_lower_for_tpu():
     ids = jnp.zeros(1024, jnp.int32)
     docs = jnp.zeros(1024, jnp.int32)
