@@ -137,12 +137,19 @@ def partition_graph(
         dangling = np.zeros(n_pad, dtype)
         dangling[:n] = dang_g
         dst2 = dst.reshape(d, e_dev)
-        local_indptr = (
-            np.stack(
-                [np.searchsorted(dst2[i], np.arange(n_pad + 1)) for i in range(d)]
-            ).astype(np.int32)
-            if need_local_indptr else np.zeros((d, 1), np.int32)
-        )
+        if need_local_indptr:
+            # Each device's slice is a contiguous run of the global
+            # dst-sorted edge array, so its CSR pointers are the global
+            # ones shifted by the slice start and clamped to the slice
+            # (padding slots fall outside every segment; they are zero-
+            # valued anyway).  Reuses the cached graph.csr_indptr().
+            g_ip = np.concatenate(
+                [graph.csr_indptr(), np.full(n_pad - n, e, np.int64)]
+            )
+            offsets = (np.arange(d, dtype=np.int64) * e_dev)[:, None]
+            local_indptr = np.clip(g_ip[None, :] - offsets, 0, e_dev).astype(np.int32)
+        else:
+            local_indptr = np.zeros((d, 1), np.int32)
         return ShardedGraph(strategy, n, n_pad, block,
                             src.reshape(d, e_dev), dst2,
                             valid.reshape(d, e_dev), inv, dangling, pad_frac,
@@ -202,12 +209,20 @@ def partition_graph(
     inv[node_map] = inv_g
     dangling = np.zeros(n_pad, dtype)
     dangling[node_map] = dang_g
-    local_indptr = (
-        np.stack(
-            [np.searchsorted(dst_local[i], np.arange(block + 1)) for i in range(d)]
-        ).astype(np.int32)
-        if need_local_indptr else np.zeros((d, 1), np.int32)
-    )
+    if need_local_indptr:
+        # Device i's edges are global rows [ebounds[i], ebounds[i+1]) — its
+        # CSR pointers are the global ones for its node range, re-based to
+        # the slice; padding node slots repeat the last pointer (empty
+        # segments) and padding edge slots fall outside every segment.
+        g_ip = graph.csr_indptr()
+        local_indptr = np.empty((d, block + 1), np.int32)
+        for i in range(d):
+            lo_n, hi_n = bounds_nodes[i], bounds_nodes[i + 1]
+            seg = (g_ip[lo_n : hi_n + 1] - ebounds[i]).astype(np.int32)
+            local_indptr[i, : seg.size] = seg
+            local_indptr[i, seg.size :] = seg[-1] if seg.size else 0
+    else:
+        local_indptr = np.zeros((d, 1), np.int32)
     return ShardedGraph(strategy, n, n_pad, block, src, dst_local, valid,
                         inv, dangling, pad_frac, node_map, local_indptr)
 

@@ -18,7 +18,6 @@ from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as tf_ops
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
     IdfMode,
     PageRankConfig,
-    TfidfConfig,
     TfMode,
 )
 
