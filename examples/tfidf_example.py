@@ -1,0 +1,43 @@
+"""End-to-end TF-IDF + query scoring via the library API.
+
+Mirrors the reference's TF-IDF chain (SURVEY.md §3.2) and the top-k query
+capability (SURVEY.md A11).
+
+Run from the repo root:  python examples/tfidf_example.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.api import tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
+    fnv1a_64,
+    hash_to_vocab,
+    tokenize,
+)
+
+names = ["spark.txt", "tpu.txt", "pagerank.txt", "tfidf.txt"]
+docs = [
+    "apache spark is a cluster computing framework",
+    "a tpu accelerates dense linear algebra with a systolic array",
+    "pagerank scores pages by the structure of the web graph",
+    "tf idf weighs terms by frequency and inverse document frequency",
+]
+
+out = tfidf(docs, vocab_bits=12, idf_mode="smooth", l2_normalize=True)
+print(f"{out.n_docs} docs, {out.nnz} nonzero (term, doc) weights")
+
+# Score documents for a query by summed TF-IDF (the reference's likely
+# takeOrdered capability, SURVEY.md A11).
+query = "spark framework"
+qids = hash_to_vocab(fnv1a_64(tokenize(query)), 12)
+scores = np.zeros(out.n_docs)
+for qid in np.unique(qids):
+    hit = out.term == qid
+    np.add.at(scores, out.doc[hit], out.weight[hit])
+for rank, d in enumerate(scores.argsort()[::-1][:3], 1):
+    print(f"  {rank}. {names[d]}  score={scores[d]:.4f}")

@@ -41,7 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spark-exact", action="store_true",
                    help="bit-exact canonical Spark example semantics")
     p.add_argument("--personalize", type=int, nargs="+", default=None,
-                   metavar="NODE", help="personalized PageRank source node(s)")
+                   metavar="NODE",
+                   help="personalized PageRank source node(s), as ORIGINAL "
+                        "ids from the input file")
     p.add_argument("--spmv-impl",
                    choices=["segment", "bcoo", "cumsum", "pallas"],
                    default="segment")

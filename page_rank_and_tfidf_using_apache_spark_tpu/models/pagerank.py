@@ -49,6 +49,7 @@ def run_pagerank(
     n = graph.n_nodes
     if n == 0:
         return PageRankResult(np.zeros(0, cfg.dtype), 0, 0.0, metrics)
+    cfg = driver.resolve_personalize(graph, cfg)
 
     dg = ops.put_graph(graph, cfg.dtype)
     e = jax.device_put(ops.restart_vector(n, cfg))

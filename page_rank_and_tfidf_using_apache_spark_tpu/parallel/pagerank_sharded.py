@@ -372,6 +372,7 @@ def run_pagerank_sharded(
     d = mesh.devices.size
     if graph.n_nodes == 0:
         return PageRankResult(np.zeros(0, cfg.dtype), 0, 0.0, metrics)
+    cfg = driver.resolve_personalize(graph, cfg)
 
     with Timer() as t_part:
         sg = partition_graph(

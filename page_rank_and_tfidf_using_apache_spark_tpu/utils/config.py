@@ -102,6 +102,10 @@ class PageRankConfig:
         object.__setattr__(self, "init", RankInit(self.init))
         if self.spark_exact and self.dangling is not DanglingMode.DROP:
             raise ValueError("spark_exact requires dangling=drop")
+        if self.spark_exact and self.personalize is not None:
+            # the canonical Spark example has no restart vector; silently
+            # ignoring --personalize would be worse than refusing
+            raise ValueError("spark_exact cannot be personalized")
         if self.spmv_impl not in ("segment", "bcoo", "cumsum", "pallas"):
             raise ValueError(f"unknown spmv_impl {self.spmv_impl!r}")
         if self.spark_exact and self.spmv_impl in ("cumsum", "pallas"):

@@ -160,6 +160,25 @@ def test_zero_iterations():
     np.testing.assert_allclose(res.ranks, 1.0)
 
 
+def test_personalize_uses_original_node_ids():
+    """SNAP inputs have id gaps; --personalize takes ORIGINAL ids and must
+    hit exactly those nodes after compaction."""
+    # ids 10, 20, 30, 40 — compacted to rows 0..3
+    edges = [(10, 20), (20, 30), (30, 10), (40, 10)]
+    g = _graph(edges)
+    res = pagerank(g, iterations=200, tol=1e-12, dangling="redistribute",
+                   init="uniform", personalize=(30,), dtype="float64")
+    G = nx.DiGraph(edges)
+    want = nx.pagerank(G, alpha=0.85, personalization={30: 1.0}, tol=1e-12,
+                       max_iter=500)
+    got = {int(g.node_ids[i]): res.ranks[i] for i in range(g.n_nodes)}
+    for node, w in want.items():
+        assert abs(got[node] - w) < 1e-9
+
+    with pytest.raises(ValueError, match="not present"):
+        pagerank(g, iterations=5, personalize=(15,))
+
+
 @pytest.mark.parametrize("impl", ["cumsum", "pallas"])
 def test_spark_exact_rejects_prefix_sum_impls(impl):
     with pytest.raises(ValueError, match="spark_exact requires"):
