@@ -2,8 +2,10 @@
 
 North-star metric (BASELINE.json:2): PageRank iterations/sec at web-Google
 scale (875K nodes / 5.1M edges, 20 iterations, damping 0.85 — config 1).
-The SNAP datasets are not mounted in this environment (SURVEY.md §6), so a
-synthetic power-law graph of identical scale stands in.
+Also reports TF-IDF throughput at 20-Newsgroups scale (config 2: batch) and
+through the streaming ingest path (config 5's mechanism) in ``extra``.
+The SNAP datasets are not mounted in this environment (SURVEY.md §6), so
+synthetic data of identical scale stands in.
 
 ``vs_baseline``: the reference publishes no numbers and pyspark is not
 installed (BASELINE.md), so the interim baseline anchor is the scipy CSR
@@ -11,15 +13,22 @@ power iteration on this host's CPU — the strongest single-process CPU
 implementation available — per BASELINE.md's "interim CPU reference point".
 The BASELINE.json target (≥20× vs 8-core Spark-local) is strictly *weaker*
 than beating scipy CSR, which does the same FLOPs without JVM/shuffle
-overhead: Spark local[8] runs this workload orders of magnitude slower than
-scipy (per-record iterator chains vs vectorized kernels).
+overhead.
+
+Dead-tunnel proofing (round-1 failure: 3×420 s timeouts, no JSON at all):
+the TPU here is reached through a relay tunnel that can be down.  Before
+any measurement the harness probes backend liveness in a ≤90 s subprocess;
+if the probe fails every measurement falls back to the JAX CPU backend and
+the output carries ``"tpu_unreachable": true`` — a valid, parseable record
+in either tunnel state.  The parent process NEVER imports jax: a process
+wedged on the dead tunnel blocks jax imports machine-wide (observed), so
+all jax work lives in subprocesses that the parent can time out and kill.
 
 Self-tuning: which SpMV formulation wins depends on how XLA/Mosaic lower
 gather, scatter and prefix sums on the present chip generation, so the
-harness races the candidate impls and reports the winner.  Each candidate
-runs in a subprocess with a timeout — a candidate that fails to compile or
-wedges the compile service costs its time budget, not the whole bench.
-Override the list with BENCH_IMPLS=a,b,c; scale with BENCH_NODES/EDGES/ITERS.
+harness races the candidate impls and reports the winner, each isolated in
+a subprocess with a timeout.  Override with BENCH_IMPLS=a,b,c; scale with
+BENCH_NODES/EDGES/ITERS; skip sections with BENCH_SKIP_TFIDF=1.
 """
 
 from __future__ import annotations
@@ -28,6 +37,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -35,17 +45,23 @@ import numpy as np
 N_NODES = int(os.environ.get("BENCH_NODES", 875_000))
 N_EDGES = int(os.environ.get("BENCH_EDGES", 5_100_000))
 ITERS = int(os.environ.get("BENCH_ITERS", 20))
+TFIDF_DOCS = int(os.environ.get("BENCH_TFIDF_DOCS", 19_000))
+TFIDF_TOKENS_PER_DOC = int(os.environ.get("BENCH_TFIDF_TOKENS_PER_DOC", 180))
 SEED = 7
 CANDIDATE_TIMEOUT_S = int(os.environ.get("BENCH_IMPL_TIMEOUT_S", 420))
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", 90))
+TFIDF_TIMEOUT_S = int(os.environ.get("BENCH_TFIDF_TIMEOUT_S", 420))
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# --------------------------------------------------------------------------
+# data generation (parent generates once, children reload via cache files)
+# --------------------------------------------------------------------------
+
 def _build_graph():
-    """Generate the bench graph — or reload the parent's copy, so candidate
-    subprocesses don't spend their timeout budget on regeneration."""
     from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
         Graph,
         synthetic_powerlaw,
@@ -53,7 +69,7 @@ def _build_graph():
 
     t0 = time.perf_counter()
     cache = os.environ.get("BENCH_GRAPH_NPZ")
-    if cache and os.path.exists(cache):
+    if cache and os.path.exists(cache) and os.path.getsize(cache) > 0:
         z = np.load(cache)
         graph = Graph(int(z["n_nodes"]), z["src"], z["dst"],
                       z["out_degree"], z["node_ids"])
@@ -71,8 +87,62 @@ def _save_graph(graph, path: str) -> None:
              out_degree=graph.out_degree, node_ids=graph.node_ids)
 
 
+def _synth_corpus_lines(n_docs: int, tokens_per_doc: int, seed: int) -> list[str]:
+    """Zipf-distributed synthetic corpus at 20-Newsgroups scale: ~19K docs,
+    Zipf unigrams over a ~50K-word vocabulary (BASELINE.json:8)."""
+    rng = np.random.default_rng(seed)
+    lens = np.maximum(
+        rng.poisson(tokens_per_doc, n_docs), 8).astype(np.int64)
+    total = int(lens.sum())
+    ids = rng.zipf(1.3, total) % 50_000
+    words = np.char.add("w", ids.astype("U6"))
+    docs, pos = [], 0
+    for ln in lens:
+        docs.append(" ".join(words[pos:pos + ln]))
+        pos += ln
+    return docs
+
+
+def _corpus(path_env: str = "BENCH_CORPUS_TXT") -> list[str]:
+    cache = os.environ.get(path_env)
+    t0 = time.perf_counter()
+    if cache and os.path.exists(cache):
+        with open(cache) as f:
+            docs = f.read().splitlines()
+        verb = "load"
+    else:
+        docs = _synth_corpus_lines(TFIDF_DOCS, TFIDF_TOKENS_PER_DOC, SEED)
+        verb = "gen"
+    log(f"corpus: {len(docs)} docs ({time.perf_counter() - t0:.1f}s {verb})")
+    return docs
+
+
+# --------------------------------------------------------------------------
+# child modes (each runs in its own process; may touch jax)
+# --------------------------------------------------------------------------
+
+def gen_graph() -> dict:
+    """Child mode: generate the bench graph and save it to BENCH_GRAPH_NPZ.
+    Runs sanitized (no axon registration) so the parent stays jax-free."""
+    graph = _build_graph()
+    _save_graph(graph, os.environ["BENCH_GRAPH_NPZ"])
+    return {"n_nodes": graph.n_nodes, "n_edges": graph.n_edges}
+
+
+def probe() -> dict:
+    """Tiny end-to-end backend check: devices + one jit + scalar fetch."""
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    y = float(jax.jit(lambda v: (v * 2).sum())(jnp.arange(8.0)))
+    assert y == 56.0
+    return {"ok": True, "backend": jax.default_backend(),
+            "devices": [str(d) for d in devs]}
+
+
 def measure_impl(impl: str) -> dict:
-    """Run one SpMV impl on the accelerator; returns {'ips':, 'checksum':}."""
+    """Run one SpMV impl on the default backend; {'ips':, 'checksum':}."""
     import jax
     import jax.numpy as jnp
 
@@ -110,24 +180,144 @@ def measure_impl(impl: str) -> dict:
     log(f"[{impl}] warm: {warm:.3f}s wall ({rtt * 1e3:.0f}ms rtt) for "
         f"{ITERS} iters -> {ips:.1f} iters/sec, checksum={checksum:.4f}, "
         f"delta={delta:.3e}")
-    return {"ips": ips, "checksum": checksum}
+    return {"ips": ips, "checksum": checksum, "backend": jax.default_backend()}
+
+
+def measure_tfidf() -> dict:
+    """TF-IDF throughput: batch pipeline (config 2) and streaming ingest
+    (config 5's mechanism), tokens/sec with the same fencing rules."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.text import tokenize_corpus
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+        run_tfidf,
+        run_tfidf_streaming,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+
+    docs = _corpus()
+    cfg = TfidfConfig(vocab_bits=18)
+    n_tokens = tokenize_corpus(docs[:64], vocab_bits=18).n_tokens  # warm cheap
+    del n_tokens
+
+    # batch: run once to compile, once warm
+    t0 = time.perf_counter()
+    out = run_tfidf(docs, cfg)
+    cold = time.perf_counter() - t0
+    tok_total = int(sum(r["tokens"] for r in out.metrics.records
+                        if r.get("event") == "tokenize"))
+    t0 = time.perf_counter()
+    out = run_tfidf(docs, cfg)
+    warm = time.perf_counter() - t0
+    batch_tps = tok_total / warm
+    log(f"[tfidf-batch] {len(docs)} docs, {tok_total} tokens: cold {cold:.2f}s "
+        f"warm {warm:.2f}s -> {batch_tps / 1e6:.2f} M tokens/s, nnz={out.nnz}")
+
+    # streaming: fixed-size chunks through the once-compiled chunk kernel
+    chunk_docs = 512
+    chunks = [docs[i:i + chunk_docs] for i in range(0, len(docs), chunk_docs)]
+    scfg = TfidfConfig(vocab_bits=18, chunk_tokens=1 << 18)
+    sout = run_tfidf_streaming(iter(chunks), scfg)  # compile + first pass
+    t0 = time.perf_counter()
+    sout = run_tfidf_streaming(iter(chunks), scfg)
+    s_warm = time.perf_counter() - t0
+    stream_tps = tok_total / s_warm
+    log(f"[tfidf-stream] {len(chunks)} chunks: warm {s_warm:.2f}s -> "
+        f"{stream_tps / 1e6:.2f} M tokens/s, nnz={sout.nnz}")
+    return {"batch_tokens_per_sec": batch_tps,
+            "stream_tokens_per_sec": stream_tps,
+            "n_tokens": tok_total, "nnz": out.nnz}
+
+
+# --------------------------------------------------------------------------
+# parent orchestration (NO jax imports in this section)
+# --------------------------------------------------------------------------
+
+def _run_child(mode: str, timeout_s: int, env: dict) -> dict | None:
+    """Run ``bench.py --<mode>`` in a subprocess; parse its last JSON line."""
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), f"--{mode}"],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+    except subprocess.TimeoutExpired as exc:
+        for stream in (exc.stderr, exc.stdout):
+            if stream:
+                sys.stderr.write(stream if isinstance(stream, str)
+                                 else stream.decode(errors="replace"))
+        log(f"[{mode}] TIMEOUT after {timeout_s}s")
+        return None
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        log(f"[{mode}] subprocess failed rc={proc.returncode}: "
+            f"{proc.stdout.strip()[-400:]}")
+        return None
+    try:
+        out = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        log(f"[{mode}] unparseable output: {proc.stdout[-400:]!r}")
+        return None
+    log(f"[{mode}] done in {time.perf_counter() - t0:.0f}s wall")
+    return out
 
 
 def main() -> int:
-    graph = _build_graph()
+    # The parent must not import jax, even transitively: the package
+    # __init__ chain reaches ``import jax``, and with a wedged process
+    # around, jax-registering interpreter startups block machine-wide
+    # (observed).  So even graph generation runs in a sanitized child;
+    # the parent only ever np.load()s the result.
+    fd, graph_cache = tempfile.mkstemp(prefix="bench_graph_", suffix=".npz")
+    os.close(fd)
+    safe_env = dict(os.environ)
+    safe_env.pop("PALLAS_AXON_POOL_IPS", None)
+    safe_env["JAX_PLATFORMS"] = "cpu"
+    gen_out = _run_child("gen-graph", 600,
+                         dict(safe_env, BENCH_GRAPH_NPZ=graph_cache))
+    if gen_out is None or os.path.getsize(graph_cache) == 0:
+        if os.path.exists(graph_cache):
+            os.unlink(graph_cache)
+        print(json.dumps({
+            "metric": "pagerank_iters_per_sec_webgoogle_scale",
+            "value": 0.0, "unit": "iters/sec (graph generation failed)",
+            "vs_baseline": 0.0, "extra": {"graph_gen_failed": True},
+        }))
+        return 0
+    z = np.load(graph_cache)
+    graph_n_nodes, graph_n_edges = int(z["n_nodes"]), int(z["src"].shape[0])
+    graph_src, graph_dst, graph_outdeg = z["src"], z["dst"], z["out_degree"]
+    log(f"graph: {graph_n_nodes} nodes, {graph_n_edges} edges (from child)")
+
+    # --- TPU liveness probe, isolated + killable (round-1 lesson) ---
+    probe_out = _run_child("probe", PROBE_TIMEOUT_S, dict(os.environ))
+    tpu_alive = bool(probe_out and probe_out.get("ok")
+                     and probe_out.get("backend") not in ("cpu",))
+    if probe_out and not tpu_alive and probe_out.get("backend") == "cpu":
+        # jax resolved to CPU on its own — no TPU plugin present
+        log("backend resolved to cpu (no TPU plugin)")
+    child_env = dict(os.environ)
+    if not tpu_alive:
+        log(f"TPU UNREACHABLE (probe={probe_out}); falling back to JAX-CPU "
+            "for all measurements")
+        # Stripping PALLAS_AXON_POOL_IPS makes the axon sitecustomize skip
+        # plugin registration entirely; while any process is wedged on the
+        # dead tunnel, interpreters that register the plugin at startup
+        # block machine-wide (observed), so CPU children must never touch it.
+        child_env.pop("PALLAS_AXON_POOL_IPS", None)
+        child_env["JAX_PLATFORMS"] = "cpu"
 
     # --- CPU anchor: scipy CSR power iteration (same math, float32) ---
     import scipy.sparse as sp
 
     a = sp.csr_matrix(
-        (np.ones(graph.n_edges, np.float32), (graph.dst, graph.src)),
-        shape=(graph.n_nodes, graph.n_nodes),
+        (np.ones(graph_n_edges, np.float32), (graph_dst, graph_src)),
+        shape=(graph_n_nodes, graph_n_nodes),
     )
-    inv = np.where(graph.out_degree > 0,
-                   1.0 / np.maximum(graph.out_degree, 1), 0.0).astype(np.float32)
-    e = np.full(graph.n_nodes, 1.0 / graph.n_nodes, np.float32)
-    dang = (graph.out_degree == 0).astype(np.float32)
-    r = np.full(graph.n_nodes, 1.0 / graph.n_nodes, np.float32)
+    inv = np.where(graph_outdeg > 0,
+                   1.0 / np.maximum(graph_outdeg, 1), 0.0).astype(np.float32)
+    e = np.full(graph_n_nodes, 1.0 / graph_n_nodes, np.float32)
+    dang = (graph_outdeg == 0).astype(np.float32)
+    r = np.full(graph_n_nodes, 1.0 / graph_n_nodes, np.float32)
     anchor_iters = 5
     t0 = time.perf_counter()
     for _ in range(anchor_iters):
@@ -138,69 +328,102 @@ def main() -> int:
     cpu_ips = anchor_iters / (time.perf_counter() - t0)
     log(f"cpu anchor (scipy CSR): {cpu_ips:.2f} iters/sec")
 
+    # --- share the generated graph with measurement children ---
+    child_env["BENCH_GRAPH_NPZ"] = graph_cache
+
     # --- accelerator: race candidates, each isolated in a subprocess ---
     # Ordered safe-first: cumsum/segment are known to compile on-chip; the
     # Pallas candidate runs LAST so a wedged Mosaic compile (killed at the
     # timeout) can never block the measurements that already succeeded.
     candidates = os.environ.get("BENCH_IMPLS", "cumsum,segment,pallas").split(",")
-    import atexit
-    import tempfile
-
-    fd, graph_cache = tempfile.mkstemp(prefix="bench_graph_", suffix=".npz")
-    os.close(fd)
-    atexit.register(lambda: os.path.exists(graph_cache) and os.unlink(graph_cache))
-    _save_graph(graph, graph_cache)
-    child_env = dict(os.environ, BENCH_GRAPH_NPZ=graph_cache)
+    if (not tpu_alive and "pallas" in candidates
+            and "BENCH_IMPLS" not in os.environ):
+        candidates.remove("pallas")  # interpret mode at 5M edges: pointless
     results: dict[str, float] = {}
-    for impl in candidates:
-        t0 = time.perf_counter()
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--impl", impl],
-                capture_output=True, text=True, timeout=CANDIDATE_TIMEOUT_S,
-                cwd=os.path.dirname(os.path.abspath(__file__)), env=child_env,
-            )
-        except subprocess.TimeoutExpired as exc:
-            for stream in (exc.stderr, exc.stdout):
-                if stream:
-                    sys.stderr.write(stream if isinstance(stream, str)
-                                     else stream.decode(errors="replace"))
-            log(f"[{impl}] TIMEOUT after {CANDIDATE_TIMEOUT_S}s; skipping")
-            continue
-        sys.stderr.write(proc.stderr)
-        if proc.returncode != 0:
-            log(f"[{impl}] subprocess failed rc={proc.returncode}: "
-                f"{proc.stdout.strip()[-400:]}")
-            continue
-        try:
-            out = json.loads(proc.stdout.strip().splitlines()[-1])
-            checksum, ips = out["checksum"], out["ips"]
-        except (json.JSONDecodeError, IndexError, KeyError, TypeError):
-            log(f"[{impl}] unparseable output: {proc.stdout[-400:]!r}")
-            continue
-        if not (0.99 < checksum < 1.01):  # mass must be conserved
-            log(f"[{impl}] BAD CHECKSUM {checksum}; discarding")
-            continue
-        results[impl] = ips
-        log(f"[{impl}] done in {time.perf_counter() - t0:.0f}s wall")
+    backend_used = "unknown"
+    try:
+        for impl in candidates:
+            out = _run_child(f"impl={impl}", CANDIDATE_TIMEOUT_S, child_env)
+            if out is None:
+                continue
+            checksum, ips = out.get("checksum"), out.get("ips")
+            if checksum is None or ips is None:
+                log(f"[{impl}] missing fields in {out}")
+                continue
+            if not (0.99 < checksum < 1.01):  # mass must be conserved
+                log(f"[{impl}] BAD CHECKSUM {checksum}; discarding")
+                continue
+            results[impl] = ips
+            backend_used = out.get("backend", backend_used)
+
+        # --- TF-IDF throughput (configs 2 and 5) ---
+        tfidf_out = None
+        if not os.environ.get("BENCH_SKIP_TFIDF"):
+            fd, corpus_cache = tempfile.mkstemp(prefix="bench_corpus_",
+                                                suffix=".txt")
+            os.close(fd)
+            with open(corpus_cache, "w") as f:
+                f.write("\n".join(_corpus()))
+            child_env["BENCH_CORPUS_TXT"] = corpus_cache
+            try:
+                tfidf_out = _run_child("tfidf", TFIDF_TIMEOUT_S, child_env)
+            finally:
+                os.unlink(corpus_cache)
+    finally:
+        if os.path.exists(graph_cache):
+            os.unlink(graph_cache)
+
+    # --- sklearn anchor for TF-IDF (same corpus would be ideal but costs
+    # parent time; a fixed-rate anchor is recorded by tools/ when needed) ---
+    extra: dict = {"tpu_unreachable": not tpu_alive, "backend": backend_used,
+                   "cpu_anchor_ips": round(cpu_ips, 2)}
+    if tfidf_out:
+        extra["tfidf_batch_tokens_per_sec"] = round(
+            tfidf_out["batch_tokens_per_sec"])
+        extra["tfidf_stream_tokens_per_sec"] = round(
+            tfidf_out["stream_tokens_per_sec"])
+
     if not results:
-        log("no SpMV impl produced a valid result")
-        return 1
+        # Still emit a parseable record with rc=0: the round's artifact must
+        # exist in every failure mode (round-1 lesson — rc=1 scored as "no
+        # number"); the record self-describes the failure in unit/extra.
+        print(json.dumps({
+            "metric": "pagerank_iters_per_sec_webgoogle_scale",
+            "value": 0.0,
+            "unit": "iters/sec (no SpMV impl produced a valid result)",
+            "vs_baseline": 0.0,
+            "extra": extra,
+        }))
+        return 0
     best = max(results, key=results.get)
-    tpu_ips = results[best]
+    ips = results[best]
+    extra["all_impls"] = {k: round(v, 2) for k, v in results.items()}
 
     print(json.dumps({
         "metric": "pagerank_iters_per_sec_webgoogle_scale",
-        "value": round(tpu_ips, 2),
-        "unit": (f"iters/sec ({graph.n_nodes} nodes, {graph.n_edges} edges, "
-                 f"f32, 1 chip, spmv={best})"),
-        "vs_baseline": round(tpu_ips / cpu_ips, 2),
+        "value": round(ips, 2),
+        "unit": (f"iters/sec ({graph_n_nodes} nodes, {graph_n_edges} edges, "
+                 f"f32, backend={backend_used}, spmv={best})"),
+        "vs_baseline": round(ips / cpu_ips, 2),
+        "extra": extra,
     }))
     return 0
 
 
 if __name__ == "__main__":
-    if len(sys.argv) == 3 and sys.argv[1] == "--impl":
+    if len(sys.argv) == 2 and sys.argv[1] == "--gen-graph":
+        print(json.dumps(gen_graph()))
+        sys.exit(0)
+    if len(sys.argv) == 2 and sys.argv[1] == "--probe":
+        print(json.dumps(probe()))
+        sys.exit(0)
+    if len(sys.argv) == 2 and sys.argv[1] == "--tfidf":
+        print(json.dumps(measure_tfidf()))
+        sys.exit(0)
+    if len(sys.argv) == 2 and sys.argv[1].startswith("--impl="):
+        print(json.dumps(measure_impl(sys.argv[1].split("=", 1)[1])))
+        sys.exit(0)
+    if len(sys.argv) == 3 and sys.argv[1] == "--impl":  # legacy spelling
         print(json.dumps(measure_impl(sys.argv[2])))
         sys.exit(0)
     sys.exit(main())

@@ -1,0 +1,138 @@
+"""Honest per-component SpMV timing (VERDICT r1 item 2).
+
+Times each stage of the PageRank SpMV pipeline at web-Google scale and emits
+ONE JSON object mapping component -> ms/op, naming the dominant stage.  This
+table decides where kernel-engineering effort goes (NOTES.md perf ideas).
+
+Method (the only protocol that yields truthful numbers on the axon tunnel,
+where ``block_until_ready()`` does not sync):
+
+- run each variant R times inside ONE jit via ``lax.fori_loop``, with a value
+  dependency chaining iterations (prevents DCE and cross-rep overlap);
+- fence by fetching a scalar to host;
+- per-op time = (T(fn_R) - T(fn_0)) / R, which subtracts compile-cache lookup,
+  dispatch, and host<->device RTT.
+
+Usage: python tools/spmv_breakdown.py [--nodes N] [--edges E] [--reps R]
+                                      [--out breakdown.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=875_000)
+    ap.add_argument("--edges", type=int, default=5_100_000)
+    ap.add_argument("--reps", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", type=str, default=None,
+                    help="also write the JSON table to this path")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import synthetic_powerlaw
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import DanglingMode
+
+    reps = args.reps
+    g = synthetic_powerlaw(args.nodes, args.edges, seed=args.seed)
+    n, n_edges = g.n_nodes, g.n_edges
+    dg = ops.put_graph(g, "float32")
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.random(n).astype(np.float32))
+    pe = jnp.asarray(rng.random(n_edges).astype(np.float32))
+    print(f"backend={jax.default_backend()} n={n} E={n_edges} reps={reps}",
+          file=sys.stderr, flush=True)
+
+    def timed(name, make_body, *arrays):
+        """make_body(x, *rest) -> array; first arg is the chained carry."""
+
+        def run_n(r):
+            @jax.jit
+            def f(x0, *rest):
+                def body(i, x):
+                    out = make_body(x, *rest)
+                    # min(out[0], 0) == 0 for non-negative data but is not
+                    # foldable, so every rep depends on the previous one.
+                    return x + jnp.minimum(out.ravel()[0], 0.0).astype(x.dtype)
+
+                return lax.fori_loop(0, r, body, x0)
+
+            return f
+
+        f0, fr = run_n(0), run_n(reps)
+        for f in (f0, fr):
+            float(f(*arrays).ravel()[0])  # compile both programs
+        t0 = time.perf_counter()
+        float(f0(*arrays).ravel()[0])
+        base = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(fr(*arrays).ravel()[0])
+        full = time.perf_counter() - t0
+        ms = max((full - base) / reps * 1e3, 0.0)
+        print(f"{name:32s} {ms:8.3f} ms  (rtt {base * 1e3:.0f} ms)",
+              file=sys.stderr, flush=True)
+        return ms
+
+    table: dict[str, float] = {}
+    src_sorted = jnp.asarray(np.sort(np.asarray(dg.src)))
+
+    table["gather_w_src"] = timed(
+        "gather w[src] [E]", lambda x, s: x[s], w, dg.src)
+    table["gather_w_src_sorted"] = timed(
+        "gather w[sorted(src)] [E]", lambda x, s: x[s], w, src_sorted)
+    table["cumsum_E"] = timed("cumsum [E]", lambda x: jnp.cumsum(x), pe)
+    table["segment_sum_E_to_N"] = timed(
+        "segment_sum [E->N]",
+        lambda x, d: jax.ops.segment_sum(
+            x, d, num_segments=n, indices_are_sorted=True),
+        pe, dg.dst)
+    table["monotone_diff_N"] = timed(
+        "diff c[indptr] [N]",
+        lambda c, ip: c[ip[1:]] - c[ip[:-1]], pe[: n + 1], dg.indptr)
+    table["spmv_cumsum"] = timed(
+        "spmv cumsum", lambda x: ops.spmv_cumsum(dg, x, n), w)
+    table["spmv_segment"] = timed(
+        "spmv segment", lambda x: ops.spmv_segment(dg, x, n), w)
+    table["full_step_cumsum"] = timed(
+        "full step (cumsum)",
+        lambda x: ops.pagerank_step(
+            x, dg, jnp.full(n, 1.0 / n, jnp.float32), n=n, damping=0.85,
+            dangling=DanglingMode.REDISTRIBUTE, total_mass=1.0, impl="cumsum"),
+        w)
+
+    components = ("gather_w_src", "cumsum_E", "segment_sum_E_to_N",
+                  "monotone_diff_N")
+    dominant = max(components, key=lambda k: table[k])
+    result = {
+        "backend": jax.default_backend(),
+        "n_nodes": n,
+        "n_edges": n_edges,
+        "reps": reps,
+        "ms_per_op": {k: round(v, 4) for k, v in table.items()},
+        "dominant_component": dominant,
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
