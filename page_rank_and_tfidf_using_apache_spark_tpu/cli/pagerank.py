@@ -56,9 +56,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", type=int, default=0,
                    help="shard over this many devices (0 = single device)")
     p.add_argument("--shard-strategy",
-                   choices=["edges", "nodes", "nodes_balanced"], default="edges",
-                   help="graph partition under --mesh: balanced edge slices / "
-                        "node blocks / edge-balanced node blocks (power-law)")
+                   choices=["auto", "edges", "nodes", "nodes_balanced",
+                            "src", "src_ring"],
+                   default="auto",
+                   help="graph partition under --mesh: auto (by memory "
+                        "footprint) / balanced edge slices / node blocks / "
+                        "edge-balanced node blocks (power-law) / source-"
+                        "block push with reduce-scatter (or explicit "
+                        "ppermute-ring) exchange")
     return p
 
 

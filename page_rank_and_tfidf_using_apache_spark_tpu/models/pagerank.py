@@ -24,6 +24,7 @@ import numpy as np
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
 from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import config
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
 
@@ -45,6 +46,7 @@ def run_pagerank(
 ) -> PageRankResult:
     """Run PageRank per ``cfg`` on the default device (single-chip path;
     the sharded multi-chip path is parallel/pagerank_sharded.py)."""
+    config.ensure_dtype_support(cfg.dtype)
     metrics = metrics or MetricsRecorder()
     n = graph.n_nodes
     if n == 0:

@@ -26,7 +26,7 @@ import numpy as np
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, TfMode
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, TfMode, ensure_dtype_support
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 
 
@@ -63,6 +63,7 @@ def run_tfidf(
     doc_names: Sequence[str] | None = None,
 ) -> TfidfOutput:
     """Batch TF-IDF: tokenize on host, one compiled device pipeline."""
+    ensure_dtype_support(cfg.dtype)
     metrics = metrics or MetricsRecorder()
     with Timer() as t_tok:
         corpus = tio.tokenize_corpus(
@@ -244,6 +245,7 @@ def run_tfidf_streaming(
     a power of two) so the device kernel compiles once; an oversized chunk
     bumps the capacity with a logged recompile (SURVEY.md §7).
     """
+    ensure_dtype_support(cfg.dtype)
     metrics = metrics or MetricsRecorder()
     vocab = cfg.vocab_size
     dtype = cfg.dtype

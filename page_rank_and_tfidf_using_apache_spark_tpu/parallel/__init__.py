@@ -8,6 +8,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.pagerank_sharded import (
     ShardedGraph,
+    auto_select_strategy,
     partition_graph,
     run_pagerank_sharded,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "replicated",
     "sharded_along",
     "ShardedGraph",
+    "auto_select_strategy",
     "partition_graph",
     "run_pagerank_sharded",
     "run_tfidf_sharded",

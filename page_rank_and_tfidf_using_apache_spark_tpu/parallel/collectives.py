@@ -45,6 +45,39 @@ def ppermute_ring(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
     return lax.ppermute(x, axis, perm)
 
 
+def ring_reduce_scatter(partial: jax.Array, axis: str) -> jax.Array:
+    """Explicit ring reduce-scatter over ``ppermute``: the edge-cut exchange
+    of SURVEY.md §2.3/§5.8 written out hop by hop.
+
+    ``partial``: [D*B] per-destination partial sums on every device.  D-1
+    steps; at each step the accumulating [B] block rotates one hop forward
+    (i → i+1) on the ICI ring while the receiver folds in its local partial
+    for that block.  Device i ends holding the complete sum for block i —
+    bit-identical (up to float add order) to :func:`reduce_scatter`, which
+    tests pin.  Exists as the hand-scheduled alternative so the exchange's
+    per-hop structure (compute/comm overlap inside the scanned loop body)
+    is explicit rather than delegated to XLA's psum_scatter lowering.
+    """
+    d = lax.axis_size(axis)
+    if d == 1:
+        return partial
+    i = lax.axis_index(axis)
+    chunks = partial.reshape(d, -1)
+
+    def chunk(c):
+        return lax.dynamic_index_in_dim(chunks, c % d, 0, keepdims=False)
+
+    # Device i seeds with its partial for block (i-1); each hop the carried
+    # block index drops by one, so after D-1 hops it holds block i complete.
+    acc = chunk(i - 1)
+
+    def body(s, acc):
+        acc = ppermute_ring(acc, axis, shift=1)  # receive from device i-1
+        return acc + chunk(i - 2 - s)
+
+    return lax.fori_loop(0, d - 1, body, acc)
+
+
 def axis_index(axis: str) -> jax.Array:
     return lax.axis_index(axis)
 

@@ -30,6 +30,20 @@ both exist):
   padded block is uniform (= the max device's node count), so per-device
   node counts are capped at 2x the equal-node block — memory stays within
   2x of ``nodes`` instead of degrading toward n*d on hub-heavy graphs.
+- ``src`` / ``src_ring``: the *push* layout (SURVEY.md §2.3 "all-to-all"
+  row, §5.8 edge-cut exchange).  Device i owns source block i — rank shard
+  plus its nodes' out-edges — so the per-edge gather reads only the local
+  1/D-sized rank block (never a gathered [n_pad] vector), each device
+  segment-sums a full per-destination partial, and one **reduce-scatter**
+  combines and re-shards it in a single collective: half the bytes of the
+  ``edges`` psum, and immune to hub *in*-degree imbalance (edges follow
+  their source; out-degree is the bounded axis of web graphs).
+  ``src_ring`` runs the identical exchange as an explicit ``ppermute``
+  ring (collectives.ring_reduce_scatter) — the hand-scheduled hop-by-hop
+  form whose equality with psum_scatter tests pin.
+- ``auto``: picks by memory footprint — ``edges`` while the replicated
+  node state fits comfortably in per-chip HBM, ``nodes_balanced`` beyond
+  (see :func:`auto_select_strategy`).
 
 Both run the whole iteration loop inside one ``jit`` + ``shard_map``
 program: collectives are compiled into the loop body, so there are zero
@@ -64,8 +78,43 @@ from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
     DanglingMode,
     PageRankConfig,
     RankInit,
+    ensure_dtype_support,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
+
+
+DEFAULT_HBM_BYTES = 8 << 30  # conservative per-chip working budget (v5e: 16G)
+
+
+def auto_select_strategy(
+    graph: Graph,
+    n_devices: int,
+    *,
+    dtype: str = "float32",
+    hbm_bytes: int | None = None,
+) -> str:
+    """Pick a shard strategy by per-chip memory footprint.
+
+    ``edges`` replicates every node-sized vector on every chip (no memory
+    scaling — the round-1 gap for soc-LiveJournal1-sized graphs), so once
+    the replicated node state plus this chip's edge slice stops fitting in
+    half the HBM working budget, switch to ``nodes_balanced``: 1/D node
+    state with edge-balanced blocks.  Overridable via the
+    ``PR_TFIDF_HBM_BYTES`` env var (tests use it to force the switch).
+    """
+    import os
+
+    if hbm_bytes is None:
+        hbm_bytes = int(os.environ.get("PR_TFIDF_HBM_BYTES", DEFAULT_HBM_BYTES))
+    item = np.dtype(dtype).itemsize
+    # replicated layout, per chip: ~6 node vectors live at once (ranks, new
+    # ranks, contribs, inv_outdeg, dangling, e) + the edge slice
+    # (src/dst int32 + valid).
+    node_state = 6 * graph.n_nodes * item
+    edge_state = (graph.n_edges / max(n_devices, 1)) * (8 + item)
+    if node_state + edge_state > hbm_bytes / 2:
+        return "nodes_balanced"
+    return "edges"
 
 
 class ShardedGraph(NamedTuple):
@@ -109,7 +158,7 @@ def partition_graph(
     only spmv_impl='cumsum' reads it, and under 'edges' it costs D
     node-sized int32 arrays (a (D, 1) placeholder is stored instead so the
     runner signature stays fixed)."""
-    if strategy not in ("edges", "nodes", "nodes_balanced"):
+    if strategy not in ("edges", "nodes", "nodes_balanced", "src", "src_ring"):
         raise ValueError(f"unknown shard strategy {strategy!r}")
     d = n_devices
     n = graph.n_nodes
@@ -119,6 +168,55 @@ def partition_graph(
         graph.out_degree > 0, 1.0 / np.maximum(graph.out_degree, 1), 0.0
     ).astype(dtype)
     dang_g = (graph.out_degree == 0).astype(dtype)
+
+    if strategy in ("src", "src_ring"):
+        # Push layout: device i owns SOURCE block [i*block, (i+1)*block) —
+        # its rank shard and its nodes' out-edges.  Contributions are
+        # computed from the local rank block alone (the per-edge gather
+        # reads a 1/D-sized table), each device segment-sums its edges into
+        # a full [n_pad] per-destination partial, and one reduce-scatter
+        # (psum_scatter, or the explicit ppermute ring under 'src_ring')
+        # both combines and re-shards it.  Hub-heavy *in*-degree (the
+        # power-law axis of web graphs) cannot imbalance this layout: edges
+        # follow their source, and out-degree is the bounded one.
+        block = max(1, math.ceil(n / d))
+        n_pad = block * d
+        owner = graph.src // block
+        order = np.lexsort((graph.dst, owner))  # by device, then dst-sorted
+        src_o = graph.src[order]
+        dst_o = graph.dst[order]
+        per = np.bincount(owner, minlength=d)
+        e_dev = max(1, int(per.max()))
+        starts = np.concatenate([[0], np.cumsum(per)])
+        src_l = np.zeros((d, e_dev), np.int32)
+        dst2 = np.full((d, e_dev), n_pad - 1, np.int32)  # pad keeps dst sorted
+        valid = np.zeros((d, e_dev), dtype)
+        for i in range(d):
+            lo, hi = starts[i], starts[i + 1]
+            k = hi - lo
+            src_l[i, :k] = src_o[lo:hi] - i * block  # block-local sources
+            dst2[i, :k] = dst_o[lo:hi]
+            valid[i, :k] = 1.0
+        pad_frac = (d * e_dev - e) / max(d * e_dev, 1)
+        inv = np.zeros(n_pad, dtype)
+        inv[:n] = inv_g
+        dangling = np.zeros(n_pad, dtype)
+        dangling[:n] = dang_g
+        if need_local_indptr:
+            # Per-device CSR pointers over the full padded destination
+            # space: each device's slice is dst-sorted, so its pointers are
+            # one searchsorted over its own slice.
+            local_indptr = np.empty((d, n_pad + 1), np.int32)
+            for i in range(d):
+                k = int(per[i])
+                local_indptr[i] = np.searchsorted(
+                    dst2[i, :k], np.arange(n_pad + 1)
+                ).astype(np.int32)
+        else:
+            local_indptr = np.zeros((d, 1), np.int32)
+        return ShardedGraph(strategy, n, n_pad, block, src_l, dst2, valid,
+                            inv, dangling, pad_frac,
+                            np.arange(n, dtype=np.int64), local_indptr)
 
     if strategy == "edges":
         block = max(1, math.ceil(n / d))
@@ -282,6 +380,30 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
         state_spec = P()  # replicated ranks
         vec_spec = P()  # inv/dangling/e replicated (step reads the full vectors)
         local_delta = lambda new, old: jnp.sum(jnp.abs(new - old))
+    elif sg.strategy in ("src", "src_ring"):
+        # Push layout: gather from the LOCAL rank block only, segment-sum
+        # into a full per-destination partial, then one reduce-scatter both
+        # combines across chips and keeps only this device's block — half
+        # the bytes of the 'edges' psum (no re-broadcast leg), and unlike
+        # 'nodes' the per-edge gather never touches a gathered [n_pad]
+        # vector.  'src_ring' runs the same exchange as an explicit
+        # ppermute ring (SURVEY.md §2.3 edge-cut row; §5.8).
+        exchange = (coll.ring_reduce_scatter if sg.strategy == "src_ring"
+                    else coll.reduce_scatter)
+
+        def step(ranks_b, src, dst, valid, ip, inv_b, dang_b, e_b):
+            weighted_b = ranks_b * inv_b  # [block], local
+            per_edge = weighted_b[src[0]] * valid[0]
+            partial = local_reduce(per_edge, dst[0], ip[0], n_pad)
+            contrib_b = exchange(partial, axis)  # [block]
+            if redistribute:
+                dmass = coll.psum(jnp.sum(ranks_b * dang_b), axis)
+                contrib_b = contrib_b + dmass * e_b
+            return (1.0 - damping) * total_mass * e_b + damping * contrib_b
+
+        state_spec = P(axis)
+        vec_spec = P(axis)
+        local_delta = lambda new, old: coll.psum(jnp.sum(jnp.abs(new - old)), axis)
     else:
         # state: [block] rank shard per device; inv/dangling/e are likewise
         # node-sharded (per-chip HBM holds only 1/D of every [n_pad] vector,
@@ -366,12 +488,16 @@ def run_pagerank_sharded(
     flags, same checkpoint segments, ranks bit-comparable across device
     counts up to float reduction order (chip-count invariance is pinned by
     tests/test_parallel.py)."""
+    ensure_dtype_support(cfg.dtype)
     metrics = metrics or MetricsRecorder()
     if mesh is None:
         mesh = make_mesh(n_devices, NODES_AXIS)
     d = mesh.devices.size
     if graph.n_nodes == 0:
         return PageRankResult(np.zeros(0, cfg.dtype), 0, 0.0, metrics)
+    if strategy == "auto":
+        strategy = auto_select_strategy(graph, d, dtype=cfg.dtype)
+        metrics.record(event="auto_strategy", chosen=strategy, devices=d)
     cfg = driver.resolve_personalize(graph, cfg)
 
     with Timer() as t_part:

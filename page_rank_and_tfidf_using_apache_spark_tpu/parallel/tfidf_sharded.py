@@ -35,7 +35,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel import collectives as coll
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import DATA_AXIS, make_mesh
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, ensure_dtype_support
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 
 
@@ -81,6 +81,7 @@ def run_tfidf_sharded(
     *chunks*, not super-chunks, so a config moved between the two paths
     checkpoints at the same cadence) and ``resume=True`` skips the
     already-ingested prefix of the iterator."""
+    ensure_dtype_support(cfg.dtype)
     metrics = metrics or MetricsRecorder()
     if mesh is None:
         mesh = make_mesh(n_devices, DATA_AXIS)

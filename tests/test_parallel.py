@@ -32,13 +32,15 @@ def single_chip_ranks(graph):
 
 
 @pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
-@pytest.mark.parametrize("strategy", ["edges", "nodes", "nodes_balanced"])
+@pytest.mark.parametrize(
+    "strategy", ["edges", "nodes", "nodes_balanced", "src", "src_ring"])
 def test_chip_count_invariance(graph, single_chip_ranks, n_devices, strategy):
     res = run_pagerank_sharded(graph, CFG, n_devices=n_devices, strategy=strategy)
     assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9
 
 
-@pytest.mark.parametrize("strategy", ["edges", "nodes", "nodes_balanced"])
+@pytest.mark.parametrize(
+    "strategy", ["edges", "nodes", "nodes_balanced", "src", "src_ring"])
 def test_sharded_cumsum_impl_matches_single_chip(graph, single_chip_ranks, strategy):
     """The scatter-free monotone-diff SpMV must agree with segment_sum in
     every sharded layout (local_indptr correctness incl. padding slots)."""
@@ -138,6 +140,61 @@ def test_partition_nodes_balanced_evens_powerlaw_edges():
                           init="uniform", dtype="float64"),
     )
     assert np.abs(res_b.ranks - res_1.ranks).sum() <= 1e-9
+
+
+def test_partition_src_covers_all_edges(graph):
+    sg = partition_graph(graph, 8, strategy="src")
+    assert int(sg.valid.sum()) == graph.n_edges
+    # sources are block-local; destinations are global padded ids, sorted
+    # per device row (pads at n_pad-1 keep the tail sorted)
+    assert (sg.src >= 0).all() and (sg.src < sg.block).all()
+    assert all((np.diff(row) >= 0).all() for row in sg.dst)
+
+
+def test_ring_reduce_scatter_matches_psum_scatter():
+    """The explicit ppermute-ring exchange must agree with XLA's
+    psum_scatter bit-for-bit in f64 on every mesh size."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import collectives as coll
+
+    rng = np.random.default_rng(3)
+    for d in (1, 2, 4, 8):
+        mesh = make_mesh(d)
+        axis = mesh.axis_names[0]
+        x = rng.random((d, d * 16))  # one [D*B] partial per device
+        ring = shard_map(
+            lambda v: coll.ring_reduce_scatter(v[0], axis)[None, :],
+            mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None),
+            check_vma=False,
+        )
+        ref = shard_map(
+            lambda v: coll.reduce_scatter(v[0], axis)[None, :],
+            mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None),
+            check_vma=False,
+        )
+        got = np.asarray(jax.jit(ring)(x))
+        want = np.asarray(jax.jit(ref)(x))
+        np.testing.assert_allclose(got, want, atol=1e-12)
+        # and both equal the plain sum-then-shard
+        np.testing.assert_allclose(
+            got.ravel(), x.sum(axis=0), atol=1e-12)
+
+
+def test_auto_select_strategy(graph):
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+        auto_select_strategy,
+    )
+
+    # tiny graph, generous budget -> replicated 'edges'
+    assert auto_select_strategy(graph, 8) == "edges"
+    # starved budget -> memory-scaling layout
+    assert auto_select_strategy(graph, 8, hbm_bytes=10_000) == "nodes_balanced"
+    res = run_pagerank_sharded(graph, CFG, n_devices=4, strategy="auto")
+    assert any(r.get("event") == "auto_strategy" for r in res.metrics.records)
 
 
 def test_spark_exact_sharded_raises(graph):

@@ -101,9 +101,12 @@ def main() -> int:
         lambda x, d: jax.ops.segment_sum(
             x, d, num_segments=n, indices_are_sorted=True),
         pe, dg.dst)
+    # the real diff stage gathers from the (E+1)-length cumsum output with
+    # indptr values up to E — shape must match or the access pattern lies
+    ce = jnp.asarray(rng.random(n_edges + 1).astype(np.float32))
     table["monotone_diff_N"] = timed(
         "diff c[indptr] [N]",
-        lambda c, ip: c[ip[1:]] - c[ip[:-1]], pe[: n + 1], dg.indptr)
+        lambda c, ip: c[ip[1:]] - c[ip[:-1]], ce, dg.indptr)
     table["spmv_cumsum"] = timed(
         "spmv cumsum", lambda x: ops.spmv_cumsum(dg, x, n), w)
     table["spmv_segment"] = timed(
