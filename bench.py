@@ -211,19 +211,29 @@ def measure_tfidf() -> dict:
     log(f"[tfidf-batch] {len(docs)} docs, {tok_total} tokens: cold {cold:.2f}s "
         f"warm {warm:.2f}s -> {batch_tps / 1e6:.2f} M tokens/s, nnz={out.nnz}")
 
-    # streaming: fixed-size chunks through the once-compiled chunk kernel
+    # streaming: fixed-size chunks through the once-compiled chunk kernel;
+    # measure the serial (prefetch=0) and double-buffered (prefetch=2)
+    # schedules separately — on TPU the pipelined one overlaps host
+    # tokenization with device compute (SURVEY.md §5.7), on the CPU backend
+    # they tie (all stages share the same saturated cores).
     chunk_docs = 512
     chunks = [docs[i:i + chunk_docs] for i in range(0, len(docs), chunk_docs)]
-    scfg = TfidfConfig(vocab_bits=18, chunk_tokens=1 << 18)
-    sout = run_tfidf_streaming(iter(chunks), scfg)  # compile + first pass
+    scfg0 = TfidfConfig(vocab_bits=18, chunk_tokens=1 << 18, prefetch=0)
+    sout = run_tfidf_streaming(iter(chunks), scfg0)  # compile + first pass
     t0 = time.perf_counter()
-    sout = run_tfidf_streaming(iter(chunks), scfg)
-    s_warm = time.perf_counter() - t0
-    stream_tps = tok_total / s_warm
-    log(f"[tfidf-stream] {len(chunks)} chunks: warm {s_warm:.2f}s -> "
-        f"{stream_tps / 1e6:.2f} M tokens/s, nnz={sout.nnz}")
+    sout = run_tfidf_streaming(iter(chunks), scfg0)
+    s_serial = time.perf_counter() - t0
+    scfg2 = TfidfConfig(vocab_bits=18, chunk_tokens=1 << 18, prefetch=2)
+    t0 = time.perf_counter()
+    sout = run_tfidf_streaming(iter(chunks), scfg2)
+    s_pipe = time.perf_counter() - t0
+    stream_tps = tok_total / min(s_serial, s_pipe)
+    log(f"[tfidf-stream] {len(chunks)} chunks: serial {s_serial:.2f}s, "
+        f"pipelined {s_pipe:.2f}s -> {stream_tps / 1e6:.2f} M tokens/s, "
+        f"overlap speedup {s_serial / s_pipe:.2f}x, nnz={sout.nnz}")
     return {"batch_tokens_per_sec": batch_tps,
             "stream_tokens_per_sec": stream_tps,
+            "stream_overlap_speedup": s_serial / s_pipe,
             "n_tokens": tok_total, "nnz": out.nnz}
 
 
@@ -399,6 +409,8 @@ def _main(graph_cache: str) -> int:
             tfidf_out["batch_tokens_per_sec"])
         extra["tfidf_stream_tokens_per_sec"] = round(
             tfidf_out["stream_tokens_per_sec"])
+        extra["tfidf_stream_overlap_speedup"] = round(
+            tfidf_out.get("stream_overlap_speedup", 1.0), 3)
 
     if not results:
         _emit(0.0, "iters/sec (no SpMV impl produced a valid result)", 0.0,

@@ -176,6 +176,38 @@ def tfidf_pipeline(
     )
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n_docs", "tf_mode", "l2_normalize"))
+def finalize_weights(
+    doc: jax.Array,  # int32 [nnz]
+    count: jax.Array,  # f[nnz]
+    doc_lengths: jax.Array,  # int32 [n_docs]
+    idf_per_pair: jax.Array,  # f[nnz] — idf[term] pre-gathered on host
+    *,
+    n_docs: int,
+    tf_mode: TfMode,
+    l2_normalize: bool,
+) -> jax.Array:
+    """Device-side second pass of the streaming ingest (SURVEY.md §5.7):
+    TF weighting + idf join + optional per-doc L2 norm over the accumulated
+    COO.  One compile at the final nnz; the elementwise math and the two
+    doc-segment reductions are where the numpy finalize spent its time at
+    Wikipedia scale."""
+    if tf_mode is TfMode.RAW:
+        tf = count
+    elif tf_mode is TfMode.FREQ:
+        tf = count / jnp.maximum(doc_lengths[doc].astype(count.dtype), 1.0)
+    elif tf_mode is TfMode.LOGNORM:
+        tf = jnp.where(count > 0, 1.0 + jnp.log(jnp.maximum(count, 1.0)), 0.0)
+    else:
+        raise ValueError(f"unknown tf mode {tf_mode}")
+    w = tf * idf_per_pair
+    if l2_normalize:
+        sq = jax.ops.segment_sum(w * w, doc, num_segments=n_docs)
+        w = w / jnp.sqrt(jnp.maximum(sq, 1e-30))[doc]
+    return w
+
+
 @functools.partial(jax.jit, static_argnames=("vocab",))
 def chunk_counts(
     doc_ids: jax.Array,

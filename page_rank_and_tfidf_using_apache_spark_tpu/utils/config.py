@@ -158,6 +158,11 @@ class TfidfConfig:
     # Streaming ingest (BASELINE.json:11): docs are fed in fixed-size chunks
     # of this many tokens; 0 = single batch.
     chunk_tokens: int = 0
+    # Double-buffered ingest (SURVEY.md §5.7): how many tokenized chunks the
+    # background tokenizer thread may run ahead of device compute, and how
+    # many launched device chunks stay in flight before the host syncs.
+    # 0 = fully serial (tokenize → compute → pull, one chunk at a time).
+    prefetch: int = 2
     checkpoint_every: int = 0  # chunks between checkpoints (0 = off)
     checkpoint_dir: str | None = None
     dtype: str = "float32"
@@ -167,6 +172,8 @@ class TfidfConfig:
             raise ValueError(f"vocab_bits must be in [1, 30], got {self.vocab_bits}")
         if self.ngram not in (1, 2):
             raise ValueError(f"ngram must be 1 or 2, got {self.ngram}")
+        if self.prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {self.prefetch}")
         object.__setattr__(self, "tf_mode", TfMode(self.tf_mode))
         object.__setattr__(self, "idf_mode", IdfMode(self.idf_mode))
 
@@ -177,7 +184,10 @@ class TfidfConfig:
     def config_hash(self) -> str:
         """Semantic fields only (chunking/checkpoint placement excluded —
         the accumulated DF/TF state is chunk-boundary-independent)."""
-        return _hash_config(self, exclude={"chunk_tokens", "checkpoint_every", "checkpoint_dir"})
+        return _hash_config(
+            self,
+            exclude={"chunk_tokens", "prefetch", "checkpoint_every", "checkpoint_dir"},
+        )
 
 
 def _to_jsonable(obj: Any) -> Any:

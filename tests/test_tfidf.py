@@ -108,6 +108,49 @@ def test_streaming_equals_batch():
     np.testing.assert_array_equal(stream.df, batch.df)
 
 
+def test_streaming_pipeline_depths_bit_identical():
+    """The double-buffered pipeline (prefetch>0) must produce bit-identical
+    output to the fully serial order — only scheduling changes."""
+    docs = [f"w{i % 13} w{i % 5} common x{i} y{i // 3}" for i in range(60)]
+    chunks = [docs[i : i + 7] for i in range(0, 60, 7)]
+    outs = []
+    for depth in (0, 1, 3):
+        cfg = TfidfConfig(vocab_bits=12, idf_mode="smooth", l2_normalize=True,
+                          prefetch=depth)
+        outs.append(run_tfidf_streaming(iter(chunks), cfg))
+    for out in outs[1:]:
+        np.testing.assert_array_equal(out.weight, outs[0].weight)
+        np.testing.assert_array_equal(out.doc, outs[0].doc)
+        np.testing.assert_array_equal(out.df, outs[0].df)
+
+
+def test_streaming_producer_exception_propagates():
+    def bad_chunks():
+        yield ["fine doc"]
+        raise RuntimeError("corpus source died")
+
+    with pytest.raises(RuntimeError, match="corpus source died"):
+        run_tfidf_streaming(bad_chunks(), TfidfConfig(vocab_bits=10))
+
+
+def test_device_finalize_matches_host(monkeypatch):
+    """ops.finalize_weights (the at-scale device second pass) must agree
+    with the numpy finalize on every tf/l2 variant."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.models import tfidf as mtfidf
+
+    docs = [f"a{i % 4} b{i % 7} c shared t{i}" for i in range(30)]
+    chunks = [docs[i : i + 6] for i in range(0, 30, 6)]
+    for tf_mode in ("raw", "freq", "lognorm"):
+        for l2 in (False, True):
+            cfg = TfidfConfig(vocab_bits=12, tf_mode=tf_mode, l2_normalize=l2)
+            host = run_tfidf_streaming(iter(chunks), cfg)
+            monkeypatch.setattr(mtfidf, "DEVICE_FINALIZE_MIN_NNZ", 0)
+            dev = run_tfidf_streaming(iter(chunks), cfg)
+            monkeypatch.undo()
+            np.testing.assert_allclose(dev.weight, host.weight, rtol=2e-6)
+            np.testing.assert_array_equal(dev.doc, host.doc)
+
+
 def test_streaming_chunk_cap_bump():
     cfg = TfidfConfig(vocab_bits=12, chunk_tokens=4)
     stream = run_tfidf_streaming([["a b c d e f g h i j"]], cfg)

@@ -1,0 +1,148 @@
+"""Wikipedia-scale streaming-ingest rehearsal (BASELINE.json:11, VERDICT r1
+item 9): push >=1M small synthetic docs through the streaming TF-IDF path
+with checkpoints enabled, and record wall time, tokens/sec, peak host RSS,
+and the serial-vs-pipelined speedup.  Emits ONE JSON object; --out writes it
+to a file (e.g. rehearsal_metrics.json at the repo root).
+
+The corpus is generated lazily chunk by chunk (never materialized — the
+whole point of streaming ingest), Zipf-distributed over a 50K-word
+vocabulary with bigrams enabled to mirror the Wikipedia config's
+"bigram vocab".
+
+Usage: python tools/streaming_rehearsal.py [--docs 1000000] [--out FILE]
+       (run with: env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu when the
+       TPU tunnel is down — see .claude/skills/verify/SKILL.md)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import resource
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VOCAB_WORDS = 50_000
+
+
+def synth_chunks(n_docs: int, docs_per_chunk: int, tokens_per_doc: int, seed: int):
+    """Lazy synthetic corpus: Zipf unigrams over a 50K-word pool."""
+    rng = np.random.default_rng(seed)
+    words = np.char.add("w", np.arange(VOCAB_WORDS).astype("U6"))
+    emitted = 0
+    while emitted < n_docs:
+        m = min(docs_per_chunk, n_docs - emitted)
+        lens = np.maximum(rng.poisson(tokens_per_doc, m), 3).astype(np.int64)
+        ids = rng.zipf(1.4, int(lens.sum())) % VOCAB_WORDS
+        toks = words[ids]
+        docs, pos = [], 0
+        for ln in lens:
+            docs.append(" ".join(toks[pos:pos + ln]))
+            pos += ln
+        yield docs
+        emitted += m
+
+
+def peak_rss_mb() -> float:
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def run_once(cfg, n_docs: int, docs_per_chunk: int, tokens_per_doc: int,
+             seed: int):
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+        run_tfidf_streaming,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import (
+        MetricsRecorder,
+    )
+
+    metrics = MetricsRecorder()
+    t0 = time.perf_counter()
+    out = run_tfidf_streaming(
+        synth_chunks(n_docs, docs_per_chunk, tokens_per_doc, seed),
+        cfg, metrics=metrics,
+    )
+    secs = time.perf_counter() - t0
+    tokens = sum(r["tokens"] for r in metrics.records if r.get("event") == "chunk")
+    return out, secs, tokens, metrics
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs", type=int, default=1_000_000)
+    ap.add_argument("--docs-per-chunk", type=int, default=8192)
+    ap.add_argument("--tokens-per-doc", type=int, default=12)
+    ap.add_argument("--vocab-bits", type=int, default=18)
+    ap.add_argument("--ngram", type=int, default=2,
+                    help="2 = uni+bigram (the Wikipedia config)")
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--checkpoint-every", type=int, default=32)
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+
+    with tempfile.TemporaryDirectory(prefix="rehearsal_ck_") as ckdir:
+        base = dict(
+            vocab_bits=args.vocab_bits, ngram=args.ngram,
+            tf_mode="freq", idf_mode="smooth", l2_normalize=True,
+            chunk_tokens=1 << 19,
+        )
+        # serial-vs-pipelined comparison at 1/8 scale (same generator seed).
+        # The first serial pass is an untimed warm-up: it compiles both the
+        # chunk kernel and the nnz-shaped finalize_weights program, so the
+        # two timed runs below (identical data, identical shapes) hit the
+        # jit cache and the comparison measures scheduling only.
+        small = max(args.docs // 8, 1)
+        run_once(TfidfConfig(**base, prefetch=0), small, args.docs_per_chunk,
+                 args.tokens_per_doc, args.seed)
+        _, serial_secs, small_tokens, _ = run_once(
+            TfidfConfig(**base, prefetch=0), small, args.docs_per_chunk,
+            args.tokens_per_doc, args.seed)
+        _, pipe_secs, _, _ = run_once(
+            TfidfConfig(**base, prefetch=2), small, args.docs_per_chunk,
+            args.tokens_per_doc, args.seed)
+
+        # the full rehearsal: checkpoints on, pipelined
+        cfg = TfidfConfig(**base, prefetch=2,
+                          checkpoint_every=args.checkpoint_every,
+                          checkpoint_dir=ckdir)
+        out, secs, tokens, metrics = run_once(
+            cfg, args.docs, args.docs_per_chunk, args.tokens_per_doc,
+            args.seed)
+        n_ckpts = sum(1 for r in metrics.records if r.get("event") == "checkpoint")
+
+    result = {
+        "backend": jax.default_backend(),
+        "n_docs": out.n_docs,
+        "n_tokens": int(tokens),
+        "nnz": out.nnz,
+        "wall_secs": round(secs, 2),
+        "tokens_per_sec": round(tokens / secs),
+        "peak_rss_mb": round(peak_rss_mb(), 1),
+        "checkpoints_written": n_ckpts,
+        "pipeline_speedup_vs_serial": round(serial_secs / pipe_secs, 3),
+        "serial_secs_eighth_scale": round(serial_secs, 2),
+        "pipelined_secs_eighth_scale": round(pipe_secs, 2),
+        "small_scale_tokens": int(small_tokens),
+        "finalize": next((r for r in metrics.records
+                          if r.get("event") == "finalize"), None),
+    }
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
