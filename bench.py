@@ -366,7 +366,8 @@ def _main(graph_cache: str) -> int:
     # Ordered safe-first: cumsum/segment are known to compile on-chip; the
     # Pallas candidate runs LAST so a wedged Mosaic compile (killed at the
     # timeout) can never block the measurements that already succeeded.
-    candidates = os.environ.get("BENCH_IMPLS", "cumsum,segment,pallas").split(",")
+    candidates = os.environ.get(
+        "BENCH_IMPLS", "cumsum,cumsum_mxu,segment,pallas").split(",")
     if (not tpu_alive and "pallas" in candidates
             and "BENCH_IMPLS" not in os.environ):
         candidates.remove("pallas")  # interpret mode at 5M edges: pointless

@@ -45,7 +45,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="personalized PageRank source node(s), as ORIGINAL "
                         "ids from the input file")
     p.add_argument("--spmv-impl",
-                   choices=["segment", "bcoo", "cumsum", "pallas"],
+                   choices=["segment", "bcoo", "cumsum", "cumsum_mxu", "pallas"],
                    default="segment")
     p.add_argument("--dtype", default="float32")
     p.add_argument("--checkpoint-dir", default=None)

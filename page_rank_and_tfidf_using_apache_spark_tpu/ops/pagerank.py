@@ -129,6 +129,32 @@ def cumsum_diff_spmv(per_edge, indptr, cumsum_fn=jnp.cumsum) -> jax.Array:
     return c0[indptr[1:]] - c0[indptr[:-1]]
 
 
+def cumsum_blocked(x: jax.Array, block: int = 128) -> jax.Array:
+    """Inclusive prefix sum as MXU work instead of XLA's reduce-window.
+
+    ``jnp.cumsum`` over millions of elements lowers to an O(E·log E)
+    reduce-window chain on TPU; here the E-length scan becomes one
+    ``[M, B] @ [B, B]`` upper-triangular matmul on the systolic array
+    (row-wise inclusive cumsum of an ``[M, B]`` reshape) plus a B×-smaller
+    recursive carry — ~2 HBM passes and trivial MXU FLOPs (E·B).  Error is
+    the blocked-summation order, no worse than the sequential scan's.
+    """
+    n = x.shape[0]
+    if n <= 4 * block:
+        return jnp.cumsum(x)
+    m = -(-n // block)
+    xp = jnp.concatenate([x, jnp.zeros(m * block - n, x.dtype)]).reshape(m, block)
+    # T[k, j] = 1 for k <= j: row-cumsum via one MXU matmul.  HIGHEST
+    # precision keeps f32 inputs f32 on TPU (default would round through
+    # bf16, breaking the "same accuracy class as the sequential scan"
+    # contract); the FLOPs are trivial either way.
+    tri = jnp.triu(jnp.ones((block, block), x.dtype))
+    rows = jnp.matmul(xp, tri, precision=jax.lax.Precision.HIGHEST)
+    row_tot = rows[:, -1]
+    carry = cumsum_blocked(row_tot, block) - row_tot  # exclusive row carry
+    return (rows + carry[:, None]).reshape(-1)[:n]
+
+
 def spmv_cumsum(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
     """Prefix-sum SpMV through ``jnp.cumsum`` — measured 1.5x faster per
     PageRank iteration than ``segment_sum`` at web-Google scale on TPU v5e,
@@ -142,6 +168,15 @@ def spmv_cumsum(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array
     return cumsum_diff_spmv(weighted_ranks[dg.src], dg.indptr)
 
 
+def spmv_cumsum_mxu(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
+    """The prefix-sum SpMV with the MXU-blocked cumsum (:func:`cumsum_blocked`)
+    as the scan primitive — same accuracy class as spmv_cumsum."""
+    if dg.indptr is None:
+        raise ValueError("spmv_impl='cumsum_mxu' needs DeviceGraph.indptr (use put_graph)")
+    return cumsum_diff_spmv(weighted_ranks[dg.src], dg.indptr,
+                            cumsum_fn=cumsum_blocked)
+
+
 def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
     if impl == "segment":
         return spmv_segment(dg, weighted, n)
@@ -149,6 +184,8 @@ def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
         return spmv_bcoo(dg, weighted, n)
     if impl == "cumsum":
         return spmv_cumsum(dg, weighted, n)
+    if impl == "cumsum_mxu":
+        return spmv_cumsum_mxu(dg, weighted, n)
     if impl == "pallas":
         from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
 

@@ -345,10 +345,10 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
         raise NotImplementedError(
             "spark_exact is a single-chip parity mode; run it without a mesh"
         )
-    if cfg.spmv_impl not in ("segment", "cumsum"):
+    if cfg.spmv_impl not in ("segment", "cumsum", "cumsum_mxu"):
         raise NotImplementedError(
             f"spmv_impl={cfg.spmv_impl!r} is not wired into the sharded "
-            "runner; use 'segment' or 'cumsum' with --mesh"
+            "runner; use 'segment', 'cumsum' or 'cumsum_mxu' with --mesh"
         )
     axis = mesh.axis_names[0]
     damping = cfg.damping
@@ -358,10 +358,13 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
 
     def local_reduce(per_edge, dst_row, ip_row, num_segments):
         """Per-device `reduceByKey` over its sorted edge slice: the shared
-        scatter-free monotone-diff skeleton under 'cumsum', segment_sum
-        otherwise."""
+        scatter-free monotone-diff skeleton under 'cumsum'/'cumsum_mxu',
+        segment_sum otherwise."""
         if cfg.spmv_impl == "cumsum":
             return ops.cumsum_diff_spmv(per_edge, ip_row)
+        if cfg.spmv_impl == "cumsum_mxu":
+            return ops.cumsum_diff_spmv(per_edge, ip_row,
+                                        cumsum_fn=ops.cumsum_blocked)
         return jax.ops.segment_sum(
             per_edge, dst_row, num_segments=num_segments, indices_are_sorted=True
         )
@@ -503,7 +506,7 @@ def run_pagerank_sharded(
     with Timer() as t_part:
         sg = partition_graph(
             graph, d, strategy=strategy, dtype=cfg.dtype,
-            need_local_indptr=cfg.spmv_impl == "cumsum",
+            need_local_indptr=cfg.spmv_impl in ("cumsum", "cumsum_mxu"),
         )
         dev = device_put_sharded_graph(sg, mesh)
     metrics.record(

@@ -122,9 +122,9 @@ class PageRankConfig:
             # the canonical Spark example has no restart vector; silently
             # ignoring --personalize would be worse than refusing
             raise ValueError("spark_exact cannot be personalized")
-        if self.spmv_impl not in ("segment", "bcoo", "cumsum", "pallas"):
+        if self.spmv_impl not in ("segment", "bcoo", "cumsum", "cumsum_mxu", "pallas"):
             raise ValueError(f"unknown spmv_impl {self.spmv_impl!r}")
-        if self.spark_exact and self.spmv_impl in ("cumsum", "pallas"):
+        if self.spark_exact and self.spmv_impl in ("cumsum", "cumsum_mxu", "pallas"):
             # spark_exact's presence test counts unit contributions through
             # the SpMV; a float32 prefix sum stops resolving +1.0 past 2^24
             # accumulated mass, silently zeroing live nodes at large-graph

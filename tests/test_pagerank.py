@@ -105,7 +105,7 @@ def test_tolerance_early_stop():
     assert res.l1_delta <= 1e-10
 
 
-@pytest.mark.parametrize("impl", ["bcoo", "cumsum", "pallas"])
+@pytest.mark.parametrize("impl", ["bcoo", "cumsum", "cumsum_mxu", "pallas"])
 def test_spmv_impls_match_segment(impl):
     g = synthetic_powerlaw(100, 400, seed=7)
     r1 = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
@@ -115,15 +115,33 @@ def test_spmv_impls_match_segment(impl):
     assert np.abs(r1.ranks - r2.ranks).max() < 1e-12
 
 
-def test_cumsum_impl_f32_accuracy():
-    """The fast prefix-sum SpMV must stay rank-accurate in float32 at a
-    scale where its accumulated error could plausibly bite."""
+@pytest.mark.parametrize("impl", ["cumsum", "cumsum_mxu"])
+def test_cumsum_impl_f32_accuracy(impl):
+    """The fast prefix-sum SpMVs must stay rank-accurate in float32 at a
+    scale where their accumulated error could plausibly bite."""
     g = synthetic_powerlaw(20_000, 100_000, seed=9)
     exact = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
                      spmv_impl="segment", dtype="float64")
     fast = pagerank(g, iterations=20, dangling="redistribute", init="uniform",
-                    spmv_impl="cumsum", dtype="float32")
+                    spmv_impl=impl, dtype="float32")
     assert np.abs(fast.ranks - exact.ranks).sum() < 1e-3
+
+
+@pytest.mark.parametrize("n", [0, 1, 5, 512, 513, 128 * 9, 40_001])
+def test_cumsum_blocked_matches_jnp(n):
+    """The MXU-blocked prefix sum must agree with jnp.cumsum for every
+    length class: empty, below the recursion base, exact multiples of the
+    block, stragglers, and multi-level recursion."""
+    import jax.numpy as jnp
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops.pagerank import cumsum_blocked
+
+    rng = np.random.default_rng(n)
+    x = jnp.asarray(rng.standard_normal(n).astype(np.float64))
+    np.testing.assert_allclose(
+        np.asarray(cumsum_blocked(x)), np.cumsum(np.asarray(x)),
+        rtol=1e-12, atol=1e-12,
+    )
 
 
 def test_spark_default_config_shape():

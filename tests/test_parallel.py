@@ -39,13 +39,16 @@ def test_chip_count_invariance(graph, single_chip_ranks, n_devices, strategy):
     assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9
 
 
+@pytest.mark.parametrize("impl", ["cumsum", "cumsum_mxu"])
 @pytest.mark.parametrize(
     "strategy", ["edges", "nodes", "nodes_balanced", "src", "src_ring"])
-def test_sharded_cumsum_impl_matches_single_chip(graph, single_chip_ranks, strategy):
-    """The scatter-free monotone-diff SpMV must agree with segment_sum in
-    every sharded layout (local_indptr correctness incl. padding slots)."""
+def test_sharded_cumsum_impl_matches_single_chip(
+        graph, single_chip_ranks, strategy, impl):
+    """The scatter-free monotone-diff SpMVs must agree with segment_sum in
+    every sharded layout (local_indptr correctness incl. padding slots —
+    and the indptr must actually be BUILT for every prefix-sum impl)."""
     cfg = PageRankConfig(iterations=30, dangling="redistribute", init="uniform",
-                         dtype="float64", spmv_impl="cumsum")
+                         dtype="float64", spmv_impl=impl)
     res = run_pagerank_sharded(graph, cfg, n_devices=8, strategy=strategy)
     assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9
 

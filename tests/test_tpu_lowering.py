@@ -28,7 +28,7 @@ def device_graph():
     return g, ops.put_graph(g, "float32")
 
 
-@pytest.mark.parametrize("impl", ["segment", "bcoo", "cumsum", "pallas"])
+@pytest.mark.parametrize("impl", ["segment", "bcoo", "cumsum", "cumsum_mxu", "pallas"])
 def test_pagerank_runner_lowers_for_tpu(device_graph, impl, monkeypatch):
     g, dg = device_graph
     # _spmv picks interpret mode from the trace-time default backend; force
@@ -57,8 +57,8 @@ def test_pagerank_tolerance_runner_lowers_for_tpu(device_graph):
     assert export.export(runner, platforms=["tpu"])(dg, r0, e).mlir_module()
 
 
-@pytest.mark.parametrize("impl", ["segment", "cumsum"])
-@pytest.mark.parametrize("strategy", ["edges", "nodes", "nodes_balanced"])
+@pytest.mark.parametrize("impl", ["segment", "cumsum", "cumsum_mxu"])
+@pytest.mark.parametrize("strategy", ["edges", "nodes", "nodes_balanced", "src", "src_ring"])
 def test_sharded_runner_lowers_for_tpu(strategy, impl):
     """The multi-chip shard_map program (collectives included) must lower
     for the TPU platform — the CPU dryrun alone cannot prove that."""

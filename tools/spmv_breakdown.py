@@ -96,6 +96,8 @@ def main() -> int:
     table["gather_w_src_sorted"] = timed(
         "gather w[sorted(src)] [E]", lambda x, s: x[s], w, src_sorted)
     table["cumsum_E"] = timed("cumsum [E]", lambda x: jnp.cumsum(x), pe)
+    table["cumsum_blocked_E"] = timed(
+        "cumsum_blocked [E] (MXU)", lambda x: ops.cumsum_blocked(x), pe)
     table["segment_sum_E_to_N"] = timed(
         "segment_sum [E->N]",
         lambda x, d: jax.ops.segment_sum(
@@ -109,6 +111,8 @@ def main() -> int:
         lambda c, ip: c[ip[1:]] - c[ip[:-1]], ce, dg.indptr)
     table["spmv_cumsum"] = timed(
         "spmv cumsum", lambda x: ops.spmv_cumsum(dg, x, n), w)
+    table["spmv_cumsum_mxu"] = timed(
+        "spmv cumsum_mxu", lambda x: ops.spmv_cumsum_mxu(dg, x, n), w)
     table["spmv_segment"] = timed(
         "spmv segment", lambda x: ops.spmv_segment(dg, x, n), w)
     table["full_step_cumsum"] = timed(
