@@ -54,6 +54,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every", type=int, default=0,
                    help="chunks between checkpoints")
     p.add_argument("--resume", action="store_true")
+    p.add_argument("--mesh", type=int, default=0,
+                   help="with --streaming: data-parallel ingest over this "
+                        "many devices (the BASELINE config-5 'TPU mesh' "
+                        "path); 0 = single device")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="tokenizer chunks to double-buffer ahead of device "
+                        "compute (0 = serial)")
     p.add_argument("--query", nargs="+", default=None, metavar="TERM",
                    help="score docs against these terms, print top-k")
     p.add_argument("--top-k", type=int, default=10)
@@ -64,6 +71,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.mesh and not args.streaming:
+        raise SystemExit("--mesh requires --streaming (chunked ingest)")
     metrics = MetricsRecorder()
 
     if args.streaming:
@@ -80,12 +89,21 @@ def main(argv: list[str] | None = None) -> int:
         l2_normalize=args.l2_normalize,
         min_token_len=args.min_token_len,
         chunk_tokens=args.chunk_tokens,
+        prefetch=args.prefetch,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
     )
-
     with trace(args.profile_dir):
-        if args.streaming:
+        if args.streaming and args.mesh:
+            from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+                run_tfidf_sharded,
+            )
+
+            out = run_tfidf_sharded(
+                iter_corpus_chunks(docs, args.chunk_docs), cfg,
+                n_devices=args.mesh, metrics=metrics, resume=args.resume,
+            )
+        elif args.streaming:
             out = run_tfidf_streaming(
                 iter_corpus_chunks(docs, args.chunk_docs), cfg,
                 metrics=metrics, resume=args.resume,

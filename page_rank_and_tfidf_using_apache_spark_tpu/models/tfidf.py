@@ -29,6 +29,7 @@ import numpy as np
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import profiling
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, TfMode, ensure_dtype_support
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 
@@ -268,14 +269,15 @@ def _tokenized_chunks(
     for i, docs in enumerate(doc_chunks):
         if i < start_chunk:
             continue  # already ingested before the resume point
-        corpus = tio.tokenize_corpus(
-            docs,
-            vocab_bits=cfg.vocab_bits,
-            ngram=cfg.ngram,
-            lowercase=cfg.lowercase,
-            min_token_len=cfg.min_token_len,
-            doc_id_offset=n_docs,
-        )
+        with profiling.annotate("tfidf_tokenize"):
+            corpus = tio.tokenize_corpus(
+                docs,
+                vocab_bits=cfg.vocab_bits,
+                ngram=cfg.ngram,
+                lowercase=cfg.lowercase,
+                min_token_len=cfg.min_token_len,
+                doc_id_offset=n_docs,
+            )
         n_docs += corpus.n_docs
         yield i, corpus
 
@@ -378,7 +380,8 @@ def run_tfidf_streaming(
     def drain_one():
         nonlocal df_total, n_docs, chunk_index, parts, doc_length_parts
         i, counts, df_inc, doc_lengths, n_chunk_docs, n_tokens, t = inflight.popleft()
-        with Timer() as t_sync:  # wait for this chunk's device results
+        with Timer() as t_sync, profiling.annotate("tfidf_chunk_sync"):
+            # wait for this chunk's device results
             k = int(counts.n_pairs)
             parts.append((np.asarray(counts.doc[:k]), np.asarray(counts.term[:k]),
                           np.asarray(counts.count[:k])))

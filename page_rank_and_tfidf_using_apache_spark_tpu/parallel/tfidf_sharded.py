@@ -27,6 +27,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
     TfidfOutput,
+    _prefetched,
+    _tokenized_chunks,
     finalize_tfidf,
     grow_chunk_cap,
     resume_ingest,
@@ -104,25 +106,23 @@ def run_tfidf_sharded(
         chunk_index, df_total, parts, doc_length_parts, n_docs = resume_ingest(cfg, metrics)
         last_ckpt = chunk_index
 
-    chunk_iter = iter(doc_chunks)
-    for _ in range(chunk_index):
-        if next(chunk_iter, None) is None:
-            break  # iterator shorter than the checkpoint; nothing left
+    # Tokenize on a background thread, up to cfg.prefetch chunks ahead
+    # (SURVEY.md §5.7 — same double-buffering as the single-chip streaming
+    # path; cfg.prefetch=0 keeps everything on the calling thread).  The
+    # consumer pulls d chunks per super-chunk incrementally, so the buffer
+    # bound stays exactly what the user asked for.
+    source = _tokenized_chunks(doc_chunks, cfg, chunk_index, n_docs)
+    if cfg.prefetch > 0:
+        source = _prefetched(source, int(cfg.prefetch))
+    chunk_iter = iter(source)
     step = 0
     while True:
         group: list[tio.TokenizedCorpus] = []
         for _ in range(d):
-            docs = next(chunk_iter, None)
-            if docs is None:
+            item = next(chunk_iter, None)
+            if item is None:
                 break
-            corpus = tio.tokenize_corpus(
-                docs,
-                vocab_bits=cfg.vocab_bits,
-                ngram=cfg.ngram,
-                lowercase=cfg.lowercase,
-                min_token_len=cfg.min_token_len,
-                doc_id_offset=n_docs,
-            )
+            _, corpus = item
             n_docs += corpus.n_docs
             group.append(corpus)
         if not group:

@@ -4,6 +4,8 @@
 import json
 import os
 
+import pytest
+
 from page_rank_and_tfidf_using_apache_spark_tpu.cli import pagerank as pr_cli
 from page_rank_and_tfidf_using_apache_spark_tpu.cli import tfidf as tfidf_cli
 
@@ -52,3 +54,32 @@ def test_tfidf_cli_lines_streaming(tmp_path):
     rc = tfidf_cli.main([str(f), "--lines", "--streaming", "--chunk-docs", "2",
                          "--vocab-bits", "12"])
     assert rc == 0
+
+
+def test_tfidf_cli_mesh_streaming_matches_single(tmp_path):
+    """--mesh N routes through the sharded ingest and must produce the same
+    weights as the single-device streaming path."""
+    f = tmp_path / "corpus.txt"
+    f.write_text("\n".join(f"w{i % 5} w{i % 3} shared t{i}" for i in range(40)))
+    single = tmp_path / "w1.tsv"
+    meshed = tmp_path / "w8.tsv"
+    assert tfidf_cli.main([str(f), "--lines", "--streaming", "--chunk-docs", "4",
+                           "--vocab-bits", "12", "--l2-normalize",
+                           "--output", str(single)]) == 0
+    assert tfidf_cli.main([str(f), "--lines", "--streaming", "--chunk-docs", "4",
+                           "--vocab-bits", "12", "--l2-normalize",
+                           "--mesh", "8", "--output", str(meshed)]) == 0
+    a = sorted(single.read_text().splitlines())
+    b = sorted(meshed.read_text().splitlines())
+    assert len(a) == len(b) > 0
+    for la, lb in zip(a, b):
+        assert la.split()[:2] == lb.split()[:2]
+        assert abs(float(la.split()[2]) - float(lb.split()[2])) < 1e-6
+
+
+def test_tfidf_cli_mesh_requires_streaming(tmp_path):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    (d / "a.txt").write_text("one doc")
+    with pytest.raises(SystemExit):
+        tfidf_cli.main([str(d), "--mesh", "4"])
