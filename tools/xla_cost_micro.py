@@ -1,0 +1,141 @@
+"""XLA-op cost model probe for the SpMV shuffle design (round 2).
+
+tools/gather_micro.py pinned Mosaic's primitives: lane gather 0.153 ns/elem
+(128-wide tiles only), sublane gather 0.082 ns (8-deep).  This script fills
+in the XLA-side costs that decide how a static permutation / shuffle routing
+network should be built around them:
+
+- row-gather from a (T, 128) table (the tile pre-fetch primitive)
+- gather from tiny tables (does XLA specialize small operands?)
+- same-shape take_along_axis along lanes (does plain XLA hit dynamic_gather?)
+- scatter-add of N values into an E array (telescoping-diff build)
+- segment_sum into few segments (hot-bin accumulate)
+- sort of E pairs (sort-as-shuffle baseline)
+- (R, 128) <-> (128, R) transpose (stage glue for routing networks)
+
+Protocol: NOTES.md fencing (fori_loop chaining, scalar fetch, 0-rep base).
+
+Usage: python tools/xla_cost_micro.py [--out xla_cost_tpu.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--edges", type=int, default=3_563_796)
+    ap.add_argument("--nodes", type=int, default=872_511)
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    E, N, reps = args.edges, args.nodes, args.reps
+    rng = np.random.default_rng(0)
+    print(f"backend={jax.default_backend()} E={E} N={N} reps={reps}",
+          file=sys.stderr, flush=True)
+
+    def timed(name, make_body, *arrays, elems=None):
+        def run_n(r):
+            @jax.jit
+            def f(x0, *rest):
+                def body(i, x):
+                    out = make_body(x, *rest)
+                    return x + jnp.minimum(
+                        out.ravel()[0].astype(x.dtype), jnp.zeros((), x.dtype))
+
+                return lax.fori_loop(0, r, body, x0)
+
+            return f
+
+        f0, fr = run_n(0), run_n(reps)
+        for f in (f0, fr):
+            float(f(*arrays).ravel()[0])
+        t0 = time.perf_counter()
+        float(f0(*arrays).ravel()[0])
+        base = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(fr(*arrays).ravel()[0])
+        full = time.perf_counter() - t0
+        ms = max((full - base) / reps * 1e3, 0.0)
+        per = f"  ({ms * 1e6 / elems:8.3f} ns/elem)" if elems else ""
+        print(f"{name:40s} {ms:9.3f} ms{per}", file=sys.stderr, flush=True)
+        rec = {"ms": round(ms, 4)}
+        if elems:
+            rec["ns_per_elem"] = round(ms * 1e6 / elems, 4)
+        return rec
+
+    t: dict[str, dict] = {}
+    tiles = -(-N // 128)
+    w2 = jnp.asarray(rng.random((tiles, 128)).astype(np.float32))
+    n_rows = -(-E // 128)
+    row_ids = jnp.asarray(
+        rng.integers(0, tiles, n_rows).astype(np.int32))
+    t["row_gather_T128"] = timed(
+        f"row-gather ({tiles},128)[{n_rows}]",
+        lambda x, ids: x[ids], w2, row_ids, elems=n_rows * 128)
+
+    small = jnp.asarray(rng.random(1024).astype(np.float32))
+    sidx = jnp.asarray(rng.integers(0, 1024, E).astype(np.int32))
+    t["gather_small_1k"] = timed(
+        "gather [E] from 1024-table", lambda x, s: x[s], small, sidx, elems=E)
+
+    med = jnp.asarray(rng.random(65536).astype(np.float32))
+    midx = jnp.asarray(rng.integers(0, 65536, E).astype(np.int32))
+    t["gather_med_64k"] = timed(
+        "gather [E] from 64K-table", lambda x, s: x[s], med, midx, elems=E)
+
+    xr = jnp.asarray(rng.random((n_rows, 128)).astype(np.float32))
+    lidx = jnp.asarray(rng.integers(0, 128, (n_rows, 128)).astype(np.int32))
+    t["xla_take_along_lanes"] = timed(
+        "XLA take_along_axis (R,128) ax1",
+        lambda x, ix: jnp.take_along_axis(x, ix, axis=1), xr, lidx,
+        elems=n_rows * 128)
+
+    e_arr = jnp.asarray(rng.random(E).astype(np.float32))
+    npos = jnp.asarray(np.sort(rng.integers(0, E, N)).astype(np.int32))
+    nvals = jnp.asarray(rng.random(N).astype(np.float32))
+    t["scatter_add_N_into_E"] = timed(
+        "scatter-add N into [E] (sorted pos)",
+        lambda x, p, v: x.at[p].add(v), e_arr, npos, nvals, elems=N)
+
+    hot_seg = jnp.asarray(rng.integers(0, 1024, E).astype(np.int32))
+    t["segment_sum_E_to_1k"] = timed(
+        "segment_sum [E] -> 1024 bins",
+        lambda x, s: jax.ops.segment_sum(x, s, num_segments=1024),
+        e_arr, hot_seg, elems=E)
+
+    skey = jnp.asarray(rng.integers(0, E, E).astype(np.int32))
+    t["sort_E_pairs"] = timed(
+        "sort [E] (i32 key, f32 val)",
+        lambda x, k: lax.sort((k, x), num_keys=1)[1], e_arr, skey, elems=E)
+
+    t["transpose_R128"] = timed(
+        "transpose (R,128)->(128,R)",
+        lambda x: x.T.reshape(n_rows, 128), xr, elems=n_rows * 128)
+
+    result = {"backend": jax.default_backend(), "E": E, "N": N,
+              "reps": reps, "ops": t}
+    line = json.dumps(result)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
