@@ -1,0 +1,35 @@
+"""graftlint — JAX/TPU-aware static analysis for this codebase (ISSUE 1).
+
+The jit-compiled cores rest on invariants nothing else enforces: hot loops
+stay inside one compiled program (no host round-trips), control flow on
+traced values goes through lax combinators, dtypes are pinned (no float64
+on TPU), shapes are static, and benchmarks fence what they time so XLA
+cannot dead-code-eliminate the measured work.  ``analysis`` machine-checks
+those invariants over the package, ``tools/`` and ``bench.py`` with a
+ratchet baseline (``analysis/baseline.json``) so existing debt is frozen
+and new violations fail CI (``tools/lint.sh``, ``tests/test_graftlint.py``).
+
+Stdlib-only on purpose: the linter must keep working when jax is broken.
+"""
+
+from page_rank_and_tfidf_using_apache_spark_tpu.analysis.engine import (
+    apply_ratchet,
+    baseline_path,
+    default_targets,
+    load_baseline,
+    repo_root,
+    run_lint,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.analysis.findings import Finding
+from page_rank_and_tfidf_using_apache_spark_tpu.analysis.rules import RULES
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "apply_ratchet",
+    "baseline_path",
+    "default_targets",
+    "load_baseline",
+    "repo_root",
+    "run_lint",
+]

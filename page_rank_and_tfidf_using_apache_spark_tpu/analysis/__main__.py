@@ -1,0 +1,111 @@
+"""graftlint CLI.
+
+Usage::
+
+    python -m page_rank_and_tfidf_using_apache_spark_tpu.analysis \
+        [paths...] [--json] [--baseline FILE | --no-baseline] \
+        [--write-baseline] [--list-rules]
+
+With no paths, scans the tier-1 surface: the package, ``tools/`` and
+``bench.py``.  Exit codes: 0 = no findings beyond the ratchet baseline,
+1 = new findings (printed), 2 = bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from page_rank_and_tfidf_using_apache_spark_tpu.analysis import engine
+from page_rank_and_tfidf_using_apache_spark_tpu.analysis.findings import (
+    render_human,
+    render_json,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.analysis.rules import RULES
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
+    ap.add_argument("paths", nargs="*", type=Path,
+                    help="files/dirs to scan (default: package + tools + bench.py)")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help="ratchet file (default: analysis/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the ratchet; report every finding and fail on any")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current findings into the baseline file "
+                         "(new entries get an UNREVIEWED placeholder "
+                         "justification you must edit)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES.values():
+            print(f"{rule.id:20s} {rule.summary}")
+        return 0
+
+    root = engine.repo_root()
+    paths = args.paths or engine.default_targets(root)
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"graftlint: no such path: {missing[0]}", file=sys.stderr)
+        return 2
+
+    findings = engine.run_lint(paths, root)
+    bl_path = args.baseline or engine.baseline_path(root)
+
+    if args.write_baseline:
+        scanned = set()
+        for f in engine.iter_python_files(paths):
+            try:
+                scanned.add(f.resolve().relative_to(root.resolve()).as_posix())
+            except ValueError:
+                scanned.add(f.as_posix())
+        engine.write_baseline(bl_path, findings, scanned_paths=scanned)
+        print(
+            f"graftlint: froze {len(findings)} finding(s) over "
+            f"{len(scanned)} file(s) into {bl_path} (entries for unscanned "
+            "files preserved)"
+        )
+        return 0
+
+    baseline = {} if args.no_baseline else engine.load_baseline(bl_path)
+    result = engine.apply_ratchet(findings, baseline)
+
+    if args.json:
+        print(
+            render_json(
+                result.new,
+                known=len(result.known),
+                stale=[e["fingerprint"] for e in result.stale],
+                ok=result.ok,
+            )
+        )
+    else:
+        if result.new:
+            print(render_human(result.new))
+            print(
+                f"\ngraftlint: {len(result.new)} new finding(s) "
+                f"({len(result.known)} baselined). Fix them, suppress with "
+                "'# graftlint: disable=<rule>' (justify in review), or — "
+                "outside hot paths — add to analysis/baseline.json with a "
+                "justification."
+            )
+        else:
+            print(
+                f"graftlint: clean ({len(result.known)} baselined finding(s) "
+                f"remain to burn down)"
+            )
+        for e in result.stale:
+            print(
+                f"graftlint: stale baseline entry {e['fingerprint']} "
+                f"({e['rule']} at {e['path']}) — finding no longer exists; "
+                "delete it from baseline.json"
+            )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,398 @@
+"""Per-file AST context shared by every graftlint rule.
+
+The rules all need the same structural facts about a module:
+
+- which function bodies are *jit contexts* — functions decorated with
+  ``@jax.jit`` / ``functools.partial(jax.jit, ...)``, functions or lambdas
+  passed to ``lax.scan`` / ``lax.while_loop`` / ``lax.fori_loop`` /
+  ``lax.cond`` / ``lax.map``, plus (same-file, call-by-name) functions
+  reachable from those — because host syncs and data-dependent shapes are
+  only hazards once XLA is tracing;
+- which names inside a jit context are *traced* (a light forward taint from
+  the function's non-static parameters, sanitized through ``.shape`` /
+  ``.ndim`` / ``.dtype`` / ``len()`` which stay static under tracing);
+- where ``# graftlint: disable=...`` suppression comments sit.
+
+Everything is lexical + same-file by design: graftlint is a ratchet, not a
+verifier, and a cheap analysis that never imports the code under scan (so
+it runs even when jax is broken) beats a precise one that cannot.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+FuncNode = ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+
+# Attribute accesses that turn a traced value back into a static one.
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+# Builtins whose result is static regardless of argument tracedness.
+STATIC_CALLS = frozenset({"len", "isinstance", "type", "id", "repr", "str"})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<file>-file)?(?:=(?P<ids>[A-Za-z0-9_,\- ]+))?"
+)
+
+# lax control-flow entry points: callee name -> positions of function args
+# (every parameter of those functions is traced).
+_LAX_HOF = {
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2, 3),
+    "switch": None,  # positions 1.. — handled specially
+    "map": (0,),
+    "associative_scan": (0,),
+}
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.lax.scan' for nested Attribute/Name chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+def _is_jit_expr(node: ast.AST) -> tuple[bool, tuple[str, ...]]:
+    """Does this decorator / call expression denote jax.jit?  Returns
+    (is_jit, static_argnames)."""
+    name = dotted_name(node)
+    if name in ("jit", "jax.jit"):
+        return True, ()
+    if isinstance(node, ast.Call):
+        cname = call_name(node)
+        if cname in ("jit", "jax.jit"):
+            return True, _static_argnames(node.keywords)
+        # functools.partial(jax.jit, static_argnames=...)
+        if cname in ("partial", "functools.partial") and node.args:
+            inner = dotted_name(node.args[0])
+            if inner in ("jit", "jax.jit"):
+                return True, _static_argnames(node.keywords)
+    return False, ()
+
+
+def _static_argnames(keywords: list[ast.keyword]) -> tuple[str, ...]:
+    for kw in keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+    return ()
+
+
+def param_names(fn: FuncNode) -> list[str]:
+    a = fn.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+class FileContext:
+    """All the per-file facts rules consume."""
+
+    def __init__(self, relpath: str, source: str, tree: ast.Module):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        # suppressions
+        self.line_suppressions: dict[int, set[str]] = {}
+        self.file_suppressions: set[str] = set()
+        for lineno, text in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            ids = (
+                {s.strip() for s in m.group("ids").split(",") if s.strip()}
+                if m.group("ids")
+                else {"all"}
+            )
+            if m.group("file"):
+                self.file_suppressions |= ids
+            else:
+                self.line_suppressions.setdefault(lineno, set()).update(ids)
+
+        # jit contexts
+        self.jit_roots: dict[FuncNode, tuple[str, ...]] = {}  # fn -> static names
+        self.lax_bodies: set[FuncNode] = set()
+        self._find_jit_roots()
+        self._find_lax_bodies()
+        self.jit_context_funcs: set[FuncNode] = set(self.jit_roots) | set(
+            self.lax_bodies
+        )
+        self._propagate_reachability()
+
+        # names bound to jit-wrapped callables at module/function level,
+        # e.g. ``run = jax.jit(loop)`` or a def decorated with @jit.
+        self.jit_value_names: set[str] = {
+            fn.name for fn in self.jit_roots if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                is_jit, _ = _is_jit_expr(node.value)
+                cname = call_name(node.value)
+                if is_jit or cname in ("jit", "jax.jit"):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.jit_value_names.add(tgt.id)
+
+    # ------------------------------------------------------------------ build
+
+    def _functions(self) -> Iterator[FuncNode]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                yield node
+
+    def _find_jit_roots(self) -> None:
+        for fn in self._functions():
+            if isinstance(fn, ast.Lambda):
+                continue
+            for dec in fn.decorator_list:
+                is_jit, static = _is_jit_expr(dec)
+                if is_jit:
+                    self.jit_roots[fn] = static
+                    break
+
+    def _find_lax_bodies(self) -> None:
+        # defs by name, for resolving ``lax.while_loop(cond, body, ...)``
+        defs_by_name: dict[str, list[FuncNode]] = {}
+        for fn in self._functions():
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(fn.name, []).append(fn)
+
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if cname is None:
+                continue
+            leaf = cname.rsplit(".", 1)[-1]
+            # bare ``map``/``cond`` shadow common host-side names; require a
+            # lax/jax prefix for those, allow bare spellings only for the
+            # unambiguous loop combinators (``from jax.lax import scan``).
+            bare_ok = leaf in ("scan", "while_loop", "fori_loop", "associative_scan")
+            root_ok = (cname == leaf and bare_ok) or cname.startswith(
+                ("lax.", "jax.lax.")
+            )
+            if leaf not in _LAX_HOF or not root_ok:
+                continue
+            positions = _LAX_HOF[leaf]
+            if positions is None:  # switch(index, [branches...]) or *branches
+                args = node.args[1:]
+            else:
+                args = [node.args[i] for i in positions if i < len(node.args)]
+            for arg in args:
+                if isinstance(arg, ast.Lambda):
+                    self.lax_bodies.add(arg)
+                elif isinstance(arg, ast.Name):
+                    for fn in defs_by_name.get(arg.id, []):
+                        self.lax_bodies.add(fn)
+                elif isinstance(arg, (ast.List, ast.Tuple)):
+                    for e in arg.elts:
+                        if isinstance(e, ast.Lambda):
+                            self.lax_bodies.add(e)
+                        elif isinstance(e, ast.Name):
+                            for fn in defs_by_name.get(e.id, []):
+                                self.lax_bodies.add(fn)
+
+    def _propagate_reachability(self) -> None:
+        """Same-file call-by-name reachability: a def called from a jit
+        context is itself a jit context (its body runs under tracing)."""
+        defs_by_name: dict[str, list[FuncNode]] = {}
+        for fn in self._functions():
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(fn.name, []).append(fn)
+
+        changed = True
+        while changed:
+            changed = False
+            for ctx_fn in list(self.jit_context_funcs):
+                for node in ast.walk(ctx_fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    cname = call_name(node)
+                    if cname is None or "." in cname:
+                        continue  # same-file plain names only
+                    for fn in defs_by_name.get(cname, []):
+                        if fn not in self.jit_context_funcs:
+                            self.jit_context_funcs.add(fn)
+                            changed = True
+
+    # ------------------------------------------------------------------ query
+
+    def enclosing_function(self, node: ast.AST) -> FuncNode | None:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def in_jit_context(self, node: ast.AST) -> bool:
+        fn = self.enclosing_function(node)
+        while fn is not None:
+            if fn in self.jit_context_funcs:
+                return True
+            fn = self.enclosing_function(fn)
+        return False
+
+    def enclosing_loops(self, node: ast.AST) -> list[ast.For | ast.While]:
+        """Python for/while statements lexically containing ``node``."""
+        out: list[ast.For | ast.While] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                out.append(cur)
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                break  # don't escape into the enclosing function's loops
+            cur = self.parents.get(cur)
+        return out
+
+    def is_suppressed(self, rule_id: str, lineno: int) -> bool:
+        if {"all", rule_id} & self.file_suppressions:
+            return True
+        ids = self.line_suppressions.get(lineno, set())
+        return bool({"all", rule_id} & ids)
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    # ------------------------------------------------------------- taint pass
+
+    def traced_names(self, fn: FuncNode) -> set[str]:
+        """Names holding traced values inside ``fn``.
+
+        Seeds: the function's parameters minus jit static_argnames (for
+        @jit roots) — or all parameters for lax loop/branch bodies.  For
+        plain defs merely *reachable* from a jit context the seed is empty:
+        whether their params are traced depends on call sites, and guessing
+        produces false tracer-branch positives (e.g. static ``impl=`` mode
+        strings threaded through helpers).  Propagates through assignments;
+        ``.shape``/``.ndim``/``.dtype``/``.size``/``len()`` sanitize.
+        """
+        traced: set[str] = set()
+        if fn in self.jit_roots:
+            static = set(self.jit_roots[fn])
+            traced |= {p for p in param_names(fn) if p not in static}
+        elif fn in self.lax_bodies:
+            traced |= set(param_names(fn))
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for _ in range(2):  # two passes reach a fixpoint for straight-line use
+            for stmt in body:
+                for node in _walk_skipping_nested_functions(stmt):
+                    targets: list[ast.expr] = []
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif isinstance(node, ast.AugAssign):
+                        targets, value = [node.target], node.value
+                    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                        targets, value = [node.target], node.value
+                    else:
+                        continue
+                    if self.expr_is_traced(value, traced):
+                        for tgt in targets:
+                            for name in _target_names(tgt):
+                                traced.add(name)
+        return traced
+
+    def expr_is_traced(self, expr: ast.AST, traced: set[str]) -> bool:
+        """Does ``expr`` (evaluated inside a jit context) yield a traced
+        value, given the currently-known traced names?"""
+        if isinstance(expr, ast.Name):
+            return expr.id in traced
+        if isinstance(expr, ast.Constant):
+            return False
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in STATIC_ATTRS:
+                return False  # x.shape et al. are static under tracing
+            return self.expr_is_traced(expr.value, traced)
+        if isinstance(expr, ast.Call):
+            cname = call_name(expr)
+            if cname in STATIC_CALLS:
+                return False
+            args = list(expr.args) + [kw.value for kw in expr.keywords]
+            if any(self.expr_is_traced(a, traced) for a in args):
+                return True
+            # method call on a traced value: x.astype(...), x.sum(), ...
+            if isinstance(expr.func, ast.Attribute):
+                return self.expr_is_traced(expr.func.value, traced)
+            return False
+        if isinstance(expr, ast.Subscript):
+            return self.expr_is_traced(expr.value, traced) or self.expr_is_traced(
+                expr.slice, traced
+            )
+        if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_is_traced(e, traced) for e in expr.elts)
+        if isinstance(expr, ast.Slice):
+            return any(
+                self.expr_is_traced(e, traced)
+                for e in (expr.lower, expr.upper, expr.step)
+                if e is not None
+            )
+        if isinstance(expr, ast.BoolOp):
+            return any(self.expr_is_traced(v, traced) for v in expr.values)
+        if isinstance(expr, ast.BinOp):
+            return self.expr_is_traced(expr.left, traced) or self.expr_is_traced(
+                expr.right, traced
+            )
+        if isinstance(expr, ast.UnaryOp):
+            return self.expr_is_traced(expr.operand, traced)
+        if isinstance(expr, ast.Compare):
+            return self.expr_is_traced(expr.left, traced) or any(
+                self.expr_is_traced(c, traced) for c in expr.comparators
+            )
+        if isinstance(expr, ast.IfExp):
+            return any(
+                self.expr_is_traced(e, traced)
+                for e in (expr.test, expr.body, expr.orelse)
+            )
+        return False
+
+
+def _target_names(tgt: ast.expr) -> Iterator[str]:
+    if isinstance(tgt, ast.Name):
+        yield tgt.id
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for e in tgt.elts:
+            yield from _target_names(e)
+    elif isinstance(tgt, ast.Starred):
+        yield from _target_names(tgt.value)
+
+
+def _walk_skipping_nested_functions(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk, but do not descend into nested function definitions (they
+    get their own taint pass)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield from _walk_skipping_nested_functions(child)

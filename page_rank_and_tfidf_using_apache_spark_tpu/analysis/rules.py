@@ -1,0 +1,574 @@
+"""graftlint rule set: the five failure classes this codebase has actually
+shipped (ISSUE 1, VERDICT.md rounds 1–5).
+
+Each rule is a function ``check(ctx: FileContext) -> Iterator[(node, msg)]``
+registered in ``RULES``.  Rules are lexical AST checks — deliberately cheap
+and import-free — tuned for the invariants the jit-compiled cores depend
+on: everything hot stays inside one compiled program, zero host round-trips
+per iteration, static shapes, no float64 on TPU, and benchmarks that
+measure work XLA cannot dead-code-eliminate.
+
+Suppress a finding with a trailing ``# graftlint: disable=<rule-id>``
+comment (comma-separate several ids, omit ``=...`` to disable all rules on
+that line), or file-wide with ``# graftlint: disable-file=<rule-id>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Iterator
+
+from page_rank_and_tfidf_using_apache_spark_tpu.analysis.context import (
+    FileContext,
+    FuncNode,
+    call_name,
+    dotted_name,
+)
+
+Hit = tuple[ast.AST, str]
+CheckFn = Callable[[FileContext], Iterator[Hit]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    summary: str
+    check: CheckFn
+
+
+RULES: dict[str, Rule] = {}
+
+
+def rule(rule_id: str, summary: str) -> Callable[[CheckFn], CheckFn]:
+    def register(fn: CheckFn) -> CheckFn:
+        RULES[rule_id] = Rule(rule_id, summary, fn)
+        return fn
+
+    return register
+
+
+# --------------------------------------------------------------------------
+# shared predicates
+# --------------------------------------------------------------------------
+
+_SYNC_METHOD_NAMES = frozenset({"block_until_ready", "item", "tolist"})
+_SYNC_CALL_NAMES = frozenset(
+    {
+        "jax.device_get",
+        "jax.block_until_ready",
+        "np.asarray",
+        "np.array",
+        "numpy.asarray",
+        "numpy.array",
+    }
+)
+_DEVICE_ROOTS = ("jnp.", "jax.", "lax.")
+
+
+def _sync_kind(node: ast.Call, ctx: FileContext, traced: set[str] | None) -> str | None:
+    """Classify a call as a host-sync construct, or None.
+
+    ``float()``/``int()`` only count when the argument is device-flavored:
+    a traced name (when taint is known) or an expression containing a
+    jax/jnp call — ``float("inf")`` and config parsing stay quiet.
+    """
+    cname = call_name(node)
+    if cname in _SYNC_CALL_NAMES:
+        return cname
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHOD_NAMES:
+        if node.func.attr == "item" and node.args:
+            return None  # dict.item(...) lookalikes take args; x.item() doesn't
+        return f".{node.func.attr}()"
+    if cname in ("float", "int") and len(node.args) == 1:
+        arg = node.args[0]
+        if traced is not None and ctx.expr_is_traced(arg, traced):
+            return f"{cname}()"
+        if _contains_device_call(arg):
+            return f"{cname}()"
+    return None
+
+
+def _contains_device_call(expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            cname = call_name(node)
+            if cname and (
+                cname.startswith(_DEVICE_ROOTS) or cname in ("jnp", "jax")
+            ):
+                return True
+    return False
+
+
+def _is_device_dispatch(node: ast.Call, ctx: FileContext) -> bool:
+    """A call that launches/transfers device work: jnp.*/jax.*/lax.* calls
+    (minus the sync constructs) or calls to names bound to jit functions."""
+    cname = call_name(node)
+    if cname is None:
+        return False
+    if cname in _SYNC_CALL_NAMES:
+        return False
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_METHOD_NAMES:
+        return False
+    if cname.startswith(_DEVICE_ROOTS):
+        return True
+    return cname in ctx.jit_value_names
+
+
+def _walk_own_body(fn: FuncNode) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs/lambdas."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+# --------------------------------------------------------------------------
+# 1. host-sync-in-loop
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "host-sync-in-loop",
+    "host round-trip (block_until_ready / device_get / np.asarray / float / "
+    ".item) inside a jit context or a device-dispatching Python loop",
+)
+def check_host_sync(ctx: FileContext) -> Iterator[Hit]:
+    taint_cache: dict[FuncNode, set[str]] = {}
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        in_jit = ctx.in_jit_context(node)
+        traced: set[str] | None = None
+        if in_jit:
+            fn = ctx.enclosing_function(node)
+            if fn is not None:
+                if fn not in taint_cache:
+                    taint_cache[fn] = ctx.traced_names(fn)
+                traced = taint_cache[fn]
+        kind = _sync_kind(node, ctx, traced)
+        if kind is None:
+            continue
+
+        if in_jit:
+            yield (
+                node,
+                f"host sync {kind} inside jit-traced code — the value is a "
+                "tracer here; hoist the transfer out of the compiled region",
+            )
+            continue
+
+        # outside jit: a sync is hot-loop poison when the same Python loop
+        # also dispatches device work — every iteration then pays a device
+        # round-trip (the exact pattern that serializes the streaming path).
+        for loop in ctx.enclosing_loops(node):
+            dispatches = any(
+                isinstance(n, ast.Call)
+                and n is not node
+                and _is_device_dispatch(n, ctx)
+                for n in ast.walk(loop)
+            )
+            if dispatches:
+                yield (
+                    node,
+                    f"host sync {kind} inside a Python loop that also "
+                    "dispatches device work — each iteration pays a "
+                    "host<->device round-trip; batch the transfer or move "
+                    "the loop into lax.scan/fori_loop",
+                )
+                break
+
+
+# --------------------------------------------------------------------------
+# 2. tracer-branch
+# --------------------------------------------------------------------------
+
+
+@rule(
+    "tracer-branch",
+    "Python if/while on a traced value inside jit — trace-time "
+    "ConcretizationError or silently trace-time-frozen branch",
+)
+def check_tracer_branch(ctx: FileContext) -> Iterator[Hit]:
+    for fn in ctx.jit_context_funcs:
+        traced = ctx.traced_names(fn)
+        if not traced:
+            continue
+        for node in _walk_own_body(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+            elif isinstance(node, ast.IfExp):
+                test = node.test
+            else:
+                continue
+            if ctx.expr_is_traced(test, traced):
+                kw = {
+                    ast.If: "if",
+                    ast.While: "while",
+                    ast.IfExp: "conditional expression",
+                }[type(node)]
+                yield (
+                    node,
+                    f"Python `{kw}` on a traced value inside jit — use "
+                    "jnp.where / lax.cond / lax.while_loop so the branch "
+                    "stays inside the compiled program",
+                )
+
+
+# --------------------------------------------------------------------------
+# 3. dtype-drift
+# --------------------------------------------------------------------------
+
+_FLOAT_DEFAULT_CTORS = frozenset({"zeros", "ones", "empty", "full", "linspace"})
+_NP_ROOTS = ("np.", "numpy.")
+
+
+def _has_dtype_arg(node: ast.Call, ctor: str) -> bool:
+    if any(kw.arg == "dtype" for kw in node.keywords):
+        return True
+    if ctor == "linspace":
+        return False  # dtype sits after retstep/axis — kwarg-only in practice
+    # positional dtype: zeros/ones/empty take it 2nd, full 3rd
+    pos = {"full": 2}.get(ctor, 1)
+    return len(node.args) > pos
+
+
+@rule(
+    "dtype-drift",
+    "float64 (explicit, or numpy/JAX float default with no dtype=) flowing "
+    "toward device arrays — unsupported/slow on TPU, silently downcast "
+    "elsewhere",
+)
+def check_dtype_drift(ctx: FileContext) -> Iterator[Hit]:
+    for node in ast.walk(ctx.tree):
+        # explicit float64 spellings
+        if isinstance(node, ast.Attribute) and node.attr in ("float64", "double"):
+            base = dotted_name(node.value)
+            if base in ("np", "numpy", "jnp", "jax.numpy"):
+                yield (
+                    node,
+                    f"explicit {base}.{node.attr} — TPU has no fast float64 "
+                    "path; pin float32/bfloat16 (or gate behind a CPU-only "
+                    "code path)",
+                )
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        if cname is None:
+            continue
+        for kw in node.keywords:
+            if (
+                kw.arg == "dtype"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value in ("float64", "f8", "double")
+            ):
+                yield (
+                    node,
+                    'dtype="float64" literal — TPU arrays must pin an '
+                    "explicit 32-bit (or narrower) dtype",
+                )
+
+        leaf = cname.rsplit(".", 1)[-1]
+        if leaf not in _FLOAT_DEFAULT_CTORS:
+            continue
+        if cname.startswith("jnp."):
+            if not _has_dtype_arg(node, leaf):
+                yield (
+                    node,
+                    f"jnp.{leaf} without dtype= — inherits the float default "
+                    "(float64 under x64), so CPU-test and TPU-prod dtypes "
+                    "drift; pass dtype explicitly",
+                )
+        elif cname.startswith(_NP_ROOTS):
+            # np float64 default flowing straight into a device transfer
+            parent = ctx.parents.get(node)
+            feeding_device = (
+                isinstance(parent, ast.Call)
+                and (call_name(parent) or "").startswith(("jnp.", "jax."))
+            )
+            if feeding_device and not _has_dtype_arg(node, leaf):
+                yield (
+                    node,
+                    f"np.{leaf} (float64 default) passed straight into a "
+                    "jax/jnp call — the transfer silently downcasts (x64 "
+                    "off) or plants float64 on device (x64 on); pass dtype=",
+                )
+
+
+# --------------------------------------------------------------------------
+# 4. nonstatic-shape
+# --------------------------------------------------------------------------
+
+_DATA_DEPENDENT_CALLS = frozenset(
+    {"nonzero", "flatnonzero", "argwhere", "unique", "compress"}
+)
+
+
+def _is_boolean_mask(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Compare):
+        return True
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Invert):
+        return _is_boolean_mask(expr.operand)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, (ast.BitAnd, ast.BitOr)):
+        return _is_boolean_mask(expr.left) or _is_boolean_mask(expr.right)
+    return False
+
+
+@rule(
+    "nonstatic-shape",
+    "data-dependent output shape inside jit (boolean-mask indexing, "
+    "nonzero/unique, traced slice bounds) — untraceable or recompiles "
+    "per value",
+)
+def check_nonstatic_shape(ctx: FileContext) -> Iterator[Hit]:
+    taint_cache: dict[FuncNode, set[str]] = {}
+
+    def traced_for(node: ast.AST) -> set[str]:
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            return set()
+        if fn not in taint_cache:
+            taint_cache[fn] = ctx.traced_names(fn)
+        return taint_cache[fn]
+
+    for node in ast.walk(ctx.tree):
+        if not ctx.in_jit_context(node):
+            continue
+        if isinstance(node, ast.Subscript):
+            if _is_boolean_mask(node.slice):
+                yield (
+                    node,
+                    "boolean-mask indexing inside jit — the result shape "
+                    "depends on data; use jnp.where(mask, x, fill) or a "
+                    "fixed-size jnp.nonzero(..., size=...)",
+                )
+            elif isinstance(node.slice, ast.Slice):
+                traced = traced_for(node)
+                bounds = [
+                    b
+                    for b in (node.slice.lower, node.slice.upper, node.slice.step)
+                    if b is not None
+                ]
+                if traced and any(ctx.expr_is_traced(b, traced) for b in bounds):
+                    yield (
+                        node,
+                        "slice bound is a traced value inside jit — the "
+                        "shape becomes data-dependent; use "
+                        "lax.dynamic_slice with a static size or mask "
+                        "instead of slicing",
+                    )
+        elif isinstance(node, ast.Call):
+            cname = call_name(node)
+            if cname is None:
+                continue
+            leaf = cname.rsplit(".", 1)[-1]
+            if leaf in _DATA_DEPENDENT_CALLS and cname.startswith(
+                ("jnp.", "jax.numpy.", "np.", "numpy.")
+            ):
+                if not any(kw.arg == "size" for kw in node.keywords):
+                    yield (
+                        node,
+                        f"{leaf}() inside jit has a data-dependent output "
+                        "shape — pass size= (with fill_value) or "
+                        "restructure to a masked fixed-shape computation",
+                    )
+            elif leaf == "where" and cname.startswith(("jnp.", "jax.numpy.")):
+                if len(node.args) + len(node.keywords) == 1:
+                    yield (
+                        node,
+                        "single-argument jnp.where inside jit returns "
+                        "data-dependent-length indices — use the "
+                        "three-argument form or nonzero(size=...)",
+                    )
+
+
+# --------------------------------------------------------------------------
+# 5. dce-timed-region
+# --------------------------------------------------------------------------
+
+_TIME_CALLS = frozenset(
+    {"time.perf_counter", "time.time", "time.monotonic", "perf_counter"}
+)
+_TIMER_NAMES = frozenset({"Timer", "timed"})
+_REGION_SYNC_OK = frozenset({"float", "int"})  # float(...) of a result fences
+
+
+def _is_time_call(expr: ast.AST) -> bool:
+    return isinstance(expr, ast.Call) and call_name(expr) in _TIME_CALLS
+
+
+def _region_has_sync(stmts: list[ast.stmt], ctx: FileContext) -> bool:
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                if _sync_kind(node, ctx, None) is not None:
+                    return True
+                cname = call_name(node)
+                if cname in _REGION_SYNC_OK and node.args:
+                    return True
+    return False
+
+
+def _names_loaded(nodes: Iterator[ast.AST] | list[ast.stmt]) -> set[str]:
+    out: set[str] = set()
+    seq = nodes if isinstance(nodes, list) else list(nodes)
+    for n in seq:
+        for sub in ast.walk(n):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                out.add(sub.id)
+    return out
+
+
+def _audit_timed_region(
+    region: list[ast.stmt],
+    after: list[ast.stmt],
+    ctx: FileContext,
+) -> Iterator[Hit]:
+    """Flag a timed region whose computed results are never consumed —
+    XLA (async dispatch + DCE) then times nothing."""
+    if _region_has_sync(region, ctx):
+        return
+    used_later = _names_loaded(after)
+    for stmt in region:
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            if _is_device_dispatch(stmt.value, ctx):
+                yield (
+                    stmt,
+                    "timed region discards a device call's result with no "
+                    "block_until_ready/host fetch — async dispatch + XLA "
+                    "DCE make the measurement meaningless",
+                )
+        elif isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            if not _is_device_dispatch(stmt.value, ctx):
+                continue
+            targets = {
+                t.id for t in stmt.targets if isinstance(t, ast.Name)
+            }
+            if targets and not (targets & used_later):
+                yield (
+                    stmt,
+                    "timed region computes a device value that is never "
+                    "read afterwards and never fenced — XLA dead-code-"
+                    "eliminates the measured work",
+                )
+
+
+@rule(
+    "dce-timed-region",
+    "timed region whose device results are unconsumed/unfenced, or a "
+    "measurement loop body consuming only one element of its result — XLA "
+    "DCEs the measured work (the tools/xla_cost_micro bug class)",
+)
+def check_dce_timed(ctx: FileContext) -> Iterator[Hit]:
+    # (a) host-level: t0 = perf_counter() ... perf_counter() - t0 regions
+    for parent in ast.walk(ctx.tree):
+        body_lists = [
+            getattr(parent, field)
+            for field in ("body", "orelse", "finalbody")
+            if isinstance(getattr(parent, field, None), list)
+        ]
+        for stmts in body_lists:
+            for i, stmt in enumerate(stmts):
+                if not (
+                    isinstance(stmt, ast.Assign)
+                    and _is_time_call(stmt.value)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                ):
+                    continue
+                t_name = stmt.targets[0].id
+                end = None
+                for j in range(i + 1, len(stmts)):
+                    for sub in ast.walk(stmts[j]):
+                        if (
+                            isinstance(sub, ast.BinOp)
+                            and isinstance(sub.op, ast.Sub)
+                            and _is_time_call(sub.left)
+                            and isinstance(sub.right, ast.Name)
+                            and sub.right.id == t_name
+                        ):
+                            end = j
+                            break
+                    if end is not None:
+                        break
+                if end is None or end == i + 1:
+                    continue
+                region, after = stmts[i + 1 : end], stmts[end:]
+                yield from _audit_timed_region(region, after, ctx)
+
+            # with Timer() as t: blocks
+            for stmt in stmts:
+                if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    continue
+                timer_like = any(
+                    isinstance(item.context_expr, ast.Call)
+                    and (call_name(item.context_expr) or "").rsplit(".", 1)[-1]
+                    in _TIMER_NAMES
+                    for item in stmt.items
+                )
+                if not timer_like:
+                    continue
+                idx = stmts.index(stmt)
+                yield from _audit_timed_region(stmt.body, stmts[idx + 1 :], ctx)
+
+    # (b) device-level: inside a lax loop body, a computed result consumed
+    # only through a constant single-element subscript (the "out.ravel()[0]"
+    # chaining bug — everything but element 0 is dead and DCEd).
+    for fn in ctx.lax_bodies:
+        body = fn.body if isinstance(fn.body, list) else []
+        for stmt in body if isinstance(body, list) else []:
+            if not (
+                isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Call)
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+            ):
+                continue
+            name = stmt.targets[0].id
+            uses = [
+                n
+                for n in _walk_own_body(fn)
+                if isinstance(n, ast.Name)
+                and n.id == name
+                and isinstance(n.ctx, ast.Load)
+            ]
+            if uses and all(_use_is_single_element(u, ctx) for u in uses):
+                yield (
+                    stmt,
+                    f"measurement loop consumes only one element of "
+                    f"`{name}` — XLA dead-code-eliminates the rest of the "
+                    "measured work; reduce over the whole result (e.g. "
+                    "jnp.abs(x).min()) to keep it live",
+                )
+
+
+def _use_is_single_element(use: ast.Name, ctx: FileContext) -> bool:
+    """True if this load feeds only a constant element access like
+    ``x[0]``, ``x[0, 0]`` or ``x.ravel()[0]``."""
+    node: ast.AST = use
+    parent = ctx.parents.get(node)
+    # allow a .ravel()/.flatten()/.reshape() hop
+    if (
+        isinstance(parent, ast.Attribute)
+        and parent.attr in ("ravel", "flatten", "reshape")
+    ):
+        grand = ctx.parents.get(parent)
+        if isinstance(grand, ast.Call):
+            node, parent = grand, ctx.parents.get(grand)
+    if isinstance(parent, ast.Subscript) and parent.value is node:
+        idx = parent.slice
+        if isinstance(idx, ast.Constant) and isinstance(idx.value, int):
+            return True
+        if isinstance(idx, ast.Tuple) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in idx.elts
+        ):
+            return True
+    return False

@@ -381,12 +381,22 @@ def run_tfidf_streaming(
         nonlocal df_total, n_docs, chunk_index, parts, doc_length_parts
         i, counts, df_inc, doc_lengths, n_chunk_docs, n_tokens, t = inflight.popleft()
         with Timer() as t_sync, profiling.annotate("tfidf_chunk_sync"):
-            # wait for this chunk's device results
-            k = int(counts.n_pairs)
-            parts.append((np.asarray(counts.doc[:k]), np.asarray(counts.term[:k]),
-                          np.asarray(counts.count[:k])))
+            # Wait for this chunk's device results with ONE batched
+            # device->host pull.  The old path paid five round-trips per
+            # chunk (int(n_pairs) fence + three sliced np.asarray pulls +
+            # the df pull) — at ~76 ms tunnel RTT that serialized the
+            # whole streaming path (VERDICT.md round 5).  Pulling the
+            # padded arrays whole costs a few MB of extra bytes but only
+            # one round-trip; the slice happens on host.
+            h_doc, h_term, h_count, h_n_pairs, h_df = jax.device_get(
+                (counts.doc, counts.term, counts.count, counts.n_pairs, df_inc)
+            )
+            k = int(h_n_pairs)
+            # .copy() so parts holds k-sized arrays, not views pinning the
+            # whole cap-sized transfer buffer until finalize
+            parts.append((h_doc[:k].copy(), h_term[:k].copy(), h_count[:k].copy()))
         doc_length_parts.append(doc_lengths)
-        df_total = df_total + np.asarray(df_inc, dtype)
+        df_total = df_total + h_df.astype(dtype)
         n_docs += n_chunk_docs
         chunk_index = i + 1
         metrics.record(event="chunk", chunk=i, docs=n_docs, tokens=n_tokens,

@@ -40,7 +40,7 @@ def reduce_scatter(x: jax.Array, axis: str) -> jax.Array:
 
 def ppermute_ring(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
     """Rotate block ``x`` ``shift`` steps around the mesh ring."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -58,7 +58,7 @@ def ring_reduce_scatter(partial: jax.Array, axis: str) -> jax.Array:
     per-hop structure (compute/comm overlap inside the scanned loop body)
     is explicit rather than delegated to XLA's psum_scatter lowering.
     """
-    d = lax.axis_size(axis)
+    d = axis_size(axis)
     if d == 1:
         return partial
     i = lax.axis_index(axis)
@@ -83,4 +83,8 @@ def axis_index(axis: str) -> jax.Array:
 
 
 def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    # Older jax has no lax.axis_size; psum of a non-tracer constant folds
+    # eagerly to ``1 * axis_size``, the canonical pmap-era idiom.
+    return lax.psum(1, axis)

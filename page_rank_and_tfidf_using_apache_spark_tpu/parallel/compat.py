@@ -1,0 +1,25 @@
+"""JAX version compatibility shims for the parallel layer.
+
+The sharded runners are written against the modern top-level
+``jax.shard_map`` API (``check_vma=`` kwarg).  Older jax releases (< 0.6)
+only ship ``jax.experimental.shard_map.shard_map`` whose replication-check
+kwarg is spelled ``check_rep``.  This module exports one ``shard_map``
+callable with the modern signature on every supported jax.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export, check_vma kwarg
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax < 0.6: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_experimental
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map_experimental(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+
+__all__ = ["shard_map"]

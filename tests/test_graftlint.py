@@ -1,0 +1,499 @@
+"""graftlint tests (ISSUE 1).
+
+Two layers:
+
+1. **Fixture snippets** — for each of the five rule classes, a true
+   positive (must flag), a true negative (must stay quiet), and a
+   suppressed positive (``# graftlint: disable=...`` must silence it).
+   Snippets are parsed, never executed, so they stay minimal.
+2. **The ratchet gate** — the analyzer runs over the real tier-1 surface
+   (the package, ``tools/``, ``bench.py``) and must report nothing beyond
+   ``analysis/baseline.json``; this is the CI gate every future PR rides
+   through (``tools/lint.sh``).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu.analysis import (
+    RULES,
+    apply_ratchet,
+    baseline_path,
+    default_targets,
+    load_baseline,
+    repo_root,
+    run_lint,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.analysis.engine import lint_file
+
+REPO = repo_root()
+
+
+def lint_snippet(tmp_path: Path, code: str):
+    f = tmp_path / "snippet.py"
+    f.write_text(code)
+    return lint_file(f, tmp_path)
+
+
+def rules_hit(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# --------------------------------------------------------------- rule 1
+
+
+HOST_SYNC_TP = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def drain(chunks):
+    out = []
+    for c in chunks:
+        y = jnp.dot(c, c)          # device dispatch in the loop...
+        out.append(np.asarray(y))  # ...and a host pull every iteration
+    return out
+"""
+
+HOST_SYNC_TP_JIT = """
+import jax
+
+@jax.jit
+def f(x):
+    y = x + 1
+    return float(y)  # concretizes a tracer
+"""
+
+HOST_SYNC_TN = """
+import numpy as np
+
+def host_only(chunks):
+    out = []
+    for c in chunks:
+        out.append(np.asarray(c))  # pure host loop, no device work
+    return out
+"""
+
+HOST_SYNC_SUPPRESSED = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def drain(chunks):
+    out = []
+    for c in chunks:
+        y = jnp.dot(c, c)
+        out.append(np.asarray(y))  # graftlint: disable=host-sync-in-loop (single batched drain)
+    return out
+"""
+
+
+def test_host_sync_true_positive(tmp_path):
+    assert "host-sync-in-loop" in rules_hit(lint_snippet(tmp_path, HOST_SYNC_TP))
+
+
+def test_host_sync_in_jit_true_positive(tmp_path):
+    findings = lint_snippet(tmp_path, HOST_SYNC_TP_JIT)
+    assert "host-sync-in-loop" in rules_hit(findings)
+
+
+def test_host_sync_true_negative(tmp_path):
+    assert "host-sync-in-loop" not in rules_hit(lint_snippet(tmp_path, HOST_SYNC_TN))
+
+
+def test_host_sync_suppressed(tmp_path):
+    assert "host-sync-in-loop" not in rules_hit(
+        lint_snippet(tmp_path, HOST_SYNC_SUPPRESSED)
+    )
+
+
+# --------------------------------------------------------------- rule 2
+
+
+TRACER_BRANCH_TP = """
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:          # Python branch on a tracer
+        return x
+    return -x
+"""
+
+TRACER_BRANCH_TN = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def g(x, flag):
+    if flag:             # static argument: branch resolves at trace time
+        return x * 2
+    if x.shape[0] == 0:  # shapes are static under tracing
+        return x
+    return x
+"""
+
+TRACER_BRANCH_SUPPRESSED = """
+import jax
+
+@jax.jit
+def f(x):
+    if x > 0:  # graftlint: disable=tracer-branch
+        return x
+    return -x
+"""
+
+
+def test_tracer_branch_true_positive(tmp_path):
+    assert "tracer-branch" in rules_hit(lint_snippet(tmp_path, TRACER_BRANCH_TP))
+
+
+def test_tracer_branch_in_scan_body(tmp_path):
+    code = """
+import jax
+from jax import lax
+
+def outer(xs):
+    def body(carry, x):
+        while carry > 0:   # tracer-hostile loop inside the scan body
+            carry = carry - x
+        return carry, x
+    return lax.scan(body, 0.0, xs)
+"""
+    assert "tracer-branch" in rules_hit(lint_snippet(tmp_path, code))
+
+
+def test_tracer_branch_true_negative(tmp_path):
+    assert "tracer-branch" not in rules_hit(lint_snippet(tmp_path, TRACER_BRANCH_TN))
+
+
+def test_tracer_branch_suppressed(tmp_path):
+    assert "tracer-branch" not in rules_hit(
+        lint_snippet(tmp_path, TRACER_BRANCH_SUPPRESSED)
+    )
+
+
+# --------------------------------------------------------------- rule 3
+
+
+DTYPE_TP = """
+import jax.numpy as jnp
+import numpy as np
+
+def build(n):
+    a = jnp.zeros(n)                 # float default drifts under x64
+    b = jnp.asarray(np.ones(n))      # numpy float64 default flows to device
+    c = np.float64(0.5)              # explicit float64
+    return a, b, c
+"""
+
+DTYPE_TN = """
+import jax.numpy as jnp
+import numpy as np
+
+def build(n):
+    a = jnp.zeros(n, jnp.float32)
+    b = jnp.asarray(np.ones(n, np.float32))
+    c = jnp.full(n, 0.5, jnp.float32)
+    d = np.zeros(n)  # host-only numpy never reaches the device here
+    return a, b, c, d
+"""
+
+DTYPE_SUPPRESSED = """
+import jax.numpy as jnp
+
+def build(n):
+    return jnp.zeros(n)  # graftlint: disable=dtype-drift
+"""
+
+
+def test_dtype_drift_true_positive(tmp_path):
+    findings = [f for f in lint_snippet(tmp_path, DTYPE_TP) if f.rule == "dtype-drift"]
+    assert len(findings) >= 3  # all three drift spellings
+
+
+def test_dtype_drift_true_negative(tmp_path):
+    assert "dtype-drift" not in rules_hit(lint_snippet(tmp_path, DTYPE_TN))
+
+
+def test_dtype_drift_suppressed(tmp_path):
+    assert "dtype-drift" not in rules_hit(lint_snippet(tmp_path, DTYPE_SUPPRESSED))
+
+
+# --------------------------------------------------------------- rule 4
+
+
+SHAPE_TP = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    pos = x[x > 0]          # boolean mask: data-dependent shape
+    idx = jnp.nonzero(x)    # ditto, no size=
+    return pos, idx
+"""
+
+SHAPE_TN = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def f(x):
+    pos = jnp.where(x > 0, x, 0.0)          # fixed-shape masking
+    idx = jnp.nonzero(x, size=8, fill_value=0)
+    return pos, idx
+
+def host_filter(a):
+    return a[a > 0]  # outside jit: plain numpy filtering is fine
+"""
+
+SHAPE_SUPPRESSED = """
+import jax
+
+@jax.jit
+def f(x):
+    return x[x > 0]  # graftlint: disable=nonstatic-shape
+"""
+
+
+def test_nonstatic_shape_true_positive(tmp_path):
+    findings = [
+        f for f in lint_snippet(tmp_path, SHAPE_TP) if f.rule == "nonstatic-shape"
+    ]
+    assert len(findings) >= 2  # mask indexing + nonzero
+
+
+def test_nonstatic_shape_traced_slice_bound(tmp_path):
+    code = """
+import jax
+
+@jax.jit
+def f(x, n):
+    k = n + 1
+    return x[:k]   # slice bound is traced -> data-dependent shape
+"""
+    assert "nonstatic-shape" in rules_hit(lint_snippet(tmp_path, code))
+
+
+def test_nonstatic_shape_true_negative(tmp_path):
+    assert "nonstatic-shape" not in rules_hit(lint_snippet(tmp_path, SHAPE_TN))
+
+
+def test_nonstatic_shape_suppressed(tmp_path):
+    assert "nonstatic-shape" not in rules_hit(lint_snippet(tmp_path, SHAPE_SUPPRESSED))
+
+
+# --------------------------------------------------------------- rule 5
+
+
+DCE_TP_REGION = """
+import time
+import jax.numpy as jnp
+
+def bench(x):
+    t0 = time.perf_counter()
+    jnp.dot(x, x)   # result discarded, nothing fenced: times dispatch only
+    return time.perf_counter() - t0
+"""
+
+DCE_TP_PARTIAL = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+def measure(reps, x0):
+    @jax.jit
+    def f(x):
+        def body(i, acc):
+            out = jnp.sin(acc)
+            return acc + out.ravel()[0]   # only element 0 is live
+        return lax.fori_loop(0, reps, body, x)
+    return f(x0)
+"""
+
+DCE_TN = """
+import time
+import jax
+import jax.numpy as jnp
+
+def bench(x):
+    t0 = time.perf_counter()
+    y = jnp.dot(x, x)
+    jax.block_until_ready(y)   # fenced: the work is measured
+    secs = time.perf_counter() - t0
+    return secs, y
+"""
+
+DCE_SUPPRESSED = """
+import time
+import jax.numpy as jnp
+
+def bench(x):
+    t0 = time.perf_counter()
+    jnp.dot(x, x)  # graftlint: disable=dce-timed-region
+    return time.perf_counter() - t0
+"""
+
+
+def test_dce_timed_region_true_positive(tmp_path):
+    assert "dce-timed-region" in rules_hit(lint_snippet(tmp_path, DCE_TP_REGION))
+
+
+def test_dce_partial_consumption_true_positive(tmp_path):
+    """The exact tools/xla_cost_micro round-5 bug shape."""
+    assert "dce-timed-region" in rules_hit(lint_snippet(tmp_path, DCE_TP_PARTIAL))
+
+
+def test_dce_timed_region_true_negative(tmp_path):
+    assert "dce-timed-region" not in rules_hit(lint_snippet(tmp_path, DCE_TN))
+
+
+def test_dce_timed_region_suppressed(tmp_path):
+    assert "dce-timed-region" not in rules_hit(lint_snippet(tmp_path, DCE_SUPPRESSED))
+
+
+# ----------------------------------------------------- engine machinery
+
+
+def test_fingerprints_stable_under_line_shift(tmp_path):
+    a = lint_snippet(tmp_path, HOST_SYNC_TP)
+    b = lint_snippet(tmp_path, "# a leading comment shifts every line\n" + HOST_SYNC_TP)
+    assert {f.fingerprint for f in a} == {f.fingerprint for f in b}
+
+
+def test_ratchet_blocks_new_but_allows_baselined(tmp_path):
+    findings = lint_snippet(tmp_path, HOST_SYNC_TP)
+    assert findings
+    baseline = {
+        f.fingerprint: {"fingerprint": f.fingerprint, "rule": f.rule, "path": f.path}
+        for f in findings
+    }
+    assert apply_ratchet(findings, baseline).ok
+    assert not apply_ratchet(findings, {}).ok
+    stale = apply_ratchet([], baseline).stale
+    assert len(stale) == len(findings)
+
+
+def test_file_level_suppression(tmp_path):
+    code = "# graftlint: disable-file=host-sync-in-loop\n" + HOST_SYNC_TP
+    assert "host-sync-in-loop" not in rules_hit(lint_snippet(tmp_path, code))
+
+
+def test_every_rule_has_summary():
+    assert set(RULES) == {
+        "host-sync-in-loop",
+        "tracer-branch",
+        "dtype-drift",
+        "nonstatic-shape",
+        "dce-timed-region",
+    }
+    for rule in RULES.values():
+        assert rule.summary
+
+
+# ----------------------------------------------------- the ratchet gate
+
+
+def test_repo_clean_under_ratchet():
+    """The tier-1 surface (package + tools/ + bench.py) must produce no
+    findings beyond analysis/baseline.json — the per-PR CI gate."""
+    findings = run_lint(default_targets(REPO), REPO)
+    baseline = load_baseline(baseline_path(REPO))
+    result = apply_ratchet(findings, baseline)
+    msg = "\n".join(f.render() for f in result.new)
+    assert result.ok, f"new graftlint findings (fix or ratchet them):\n{msg}"
+
+
+def test_hot_path_inline_suppressions_are_justified():
+    """ops/ and parallel/ may suppress inline only with named rules AND a
+    parenthesized justification on the same line — no silent opt-outs."""
+    import re
+
+    pkg = REPO / "page_rank_and_tfidf_using_apache_spark_tpu"
+    justified = re.compile(
+        r"graftlint:\s*disable(?:-file)?=[A-Za-z0-9_,\- ]+?\s*\(.+\)"
+    )
+    for hot in ("ops", "parallel"):
+        for f in sorted((pkg / hot).rglob("*.py")):
+            for lineno, line in enumerate(f.read_text().splitlines(), 1):
+                if "graftlint:" in line and "disable" in line:
+                    assert justified.search(line), (
+                        f"{f.relative_to(REPO)}:{lineno}: hot-path "
+                        "suppression must name its rule(s) and carry a "
+                        f"(justification): {line.strip()}"
+                    )
+
+
+def test_write_baseline_preserves_unscanned_entries(tmp_path):
+    """A partial --write-baseline must not wipe ratchet entries for files
+    outside the scanned set."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.analysis.engine import (
+        write_baseline,
+    )
+
+    bl = tmp_path / "baseline.json"
+    (tmp_path / "a.py").write_text(HOST_SYNC_TP)
+    (tmp_path / "b.py").write_text(TRACER_BRANCH_TP)
+    both = run_lint([tmp_path / "a.py", tmp_path / "b.py"], tmp_path)
+    write_baseline(bl, both, scanned_paths={"a.py", "b.py"})
+    assert {e["path"] for e in load_baseline(bl).values()} == {"a.py", "b.py"}
+
+    only_a = run_lint([tmp_path / "a.py"], tmp_path)
+    write_baseline(bl, only_a, scanned_paths={"a.py"})
+    kept = load_baseline(bl)
+    assert {e["path"] for e in kept.values()} == {"a.py", "b.py"}
+
+
+def test_baseline_entries_are_justified():
+    """Every frozen finding needs a real one-line justification, and none
+    may silently live in the hot-path modules."""
+    baseline = load_baseline(baseline_path(REPO))
+    for entry in baseline.values():
+        just = entry.get("justification", "")
+        assert just and "UNREVIEWED" not in just, entry
+        assert not entry["path"].startswith(
+            ("page_rank_and_tfidf_using_apache_spark_tpu/ops/",
+             "page_rank_and_tfidf_using_apache_spark_tpu/parallel/")
+        ), f"hot-path module may not carry baselined findings: {entry}"
+
+
+def test_lint_cli_gate():
+    """tools/lint.sh (the CI entry point) exits 0 on the current tree."""
+    proc = subprocess.run(
+        [str(REPO / "tools" / "lint.sh")],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_lint_cli_json_output(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text(TRACER_BRANCH_TP)
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "page_rank_and_tfidf_using_apache_spark_tpu.analysis",
+            str(f),
+            "--json",
+            "--no-baseline",
+        ],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is False
+    assert any(x["rule"] == "tracer-branch" for x in payload["findings"])

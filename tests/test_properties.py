@@ -2,7 +2,12 @@
 invariance, hashed-vocab ≈ exact-vocab convergence."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from page_rank_and_tfidf_using_apache_spark_tpu import pagerank, tfidf
 from page_rank_and_tfidf_using_apache_spark_tpu.io import from_edges

@@ -1,0 +1,111 @@
+"""TF-IDF oracle anchor (ISSUE 1 satellite): pin ``models/tfidf.py``
+against an independently-computed sklearn-style reference on the
+``tests/fixtures/tiny.txt`` corpus (each line of the fixture is one
+document).
+
+Smoothing convention documented and pinned here — the sklearn
+``TfidfVectorizer(smooth_idf=True, sublinear_tf=False, norm="l2")``
+formula, which this framework spells ``idf_mode="smooth"``:
+
+    idf(t)  = ln((1 + N) / (1 + df(t))) + 1
+    tf(t,d) = raw count of t in d
+    w(t,d)  = tf(t,d) * idf(t), then each document L2-normalized.
+
+The reference below is hand-rolled numpy over the package's own tokenizer
+and hashed vocabulary (collisions must fold identically on both sides),
+so it anchors the *numeric pipeline* — sort+RLE counting, segment-sum DF,
+the IDF join, the per-doc L2 reduction — not the tokenizer.
+"""
+
+from __future__ import annotations
+
+import math
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
+    fnv1a_64,
+    hash_to_vocab,
+    tokenize,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+
+FIXTURE = Path(__file__).parent / "fixtures" / "tiny.txt"
+VOCAB_BITS = 10
+
+
+def _corpus() -> list[str]:
+    return FIXTURE.read_text().splitlines()
+
+
+def _hashed(tok: str) -> int:
+    return int(hash_to_vocab(fnv1a_64([tok]), VOCAB_BITS)[0])
+
+
+def _reference_dense(docs: list[str]) -> np.ndarray:
+    """sklearn-convention TF-IDF matrix, computed with dicts + math.log."""
+    n = len(docs)
+    vocab = 1 << VOCAB_BITS
+    tok_docs = [[_hashed(t) for t in tokenize(d)] for d in docs]
+
+    df = np.zeros(vocab)
+    for toks in tok_docs:
+        for h in set(toks):
+            df[h] += 1
+    idf = np.zeros(vocab)
+    for h in range(vocab):
+        if df[h] > 0:
+            idf[h] = math.log((1.0 + n) / (1.0 + df[h])) + 1.0
+
+    dense = np.zeros((n, vocab))
+    for d, toks in enumerate(tok_docs):
+        for h in toks:
+            dense[d, h] += 1.0  # raw tf
+        dense[d] *= idf
+        norm = math.sqrt((dense[d] ** 2).sum())
+        if norm > 0:
+            dense[d] /= norm
+    return dense
+
+
+def test_tiny_corpus_matches_sklearn_formula():
+    docs = _corpus()
+    assert len(docs) >= 8, "fixture should exercise several documents"
+
+    out = run_tfidf(
+        docs,
+        TfidfConfig(
+            vocab_bits=VOCAB_BITS,
+            tf_mode="raw",
+            idf_mode="smooth",
+            l2_normalize=True,
+        ),
+    )
+    got = out.to_dense()
+    want = _reference_dense(docs)
+
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-7)
+
+
+def test_tiny_corpus_df_and_idf_match_reference():
+    docs = _corpus()
+    out = run_tfidf(
+        docs,
+        TfidfConfig(
+            vocab_bits=VOCAB_BITS,
+            tf_mode="raw",
+            idf_mode="smooth",
+            l2_normalize=True,
+        ),
+    )
+    n = len(docs)
+    tok_docs = [{_hashed(t) for t in tokenize(d)} for d in docs]
+    for h in range(1 << VOCAB_BITS):
+        df = sum(1 for toks in tok_docs if h in toks)
+        assert out.df[h] == pytest.approx(df)
+        want_idf = math.log((1.0 + n) / (1.0 + df)) + 1.0 if df else 0.0
+        assert out.idf[h] == pytest.approx(want_idf, rel=1e-6)

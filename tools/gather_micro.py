@@ -103,7 +103,13 @@ def main() -> int:
             def f(x0, ix):
                 def body(i, acc):
                     out = call(acc, ix)
-                    return acc + jnp.minimum(out[0, 0], 0.0)
+                    # Reduce over the WHOLE kernel output: min(|out|) is
+                    # >= 0 so the minimum with 0 keeps the carry unchanged,
+                    # while the value dependency covers every gathered
+                    # element — XLA cannot DCE the pallas_call.  (The old
+                    # out[0, 0] consumption produced the physically
+                    # impossible 0.0 ns/gather "bcast_w128" artifact.)
+                    return acc + jnp.minimum(jnp.abs(out).min(), 0.0)
 
                 return lax.fori_loop(0, r, body, x0)
 

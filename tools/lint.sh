@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# graftlint CI gate: fail on any finding not frozen in analysis/baseline.json.
+#
+# Runs the AST analyzer over the tier-1 surface (the package, tools/,
+# bench.py).  Exit 0 = clean under the ratchet; exit 1 = new findings —
+# fix them, suppress with a justified "# graftlint: disable=<rule>"
+# comment, or (outside ops//parallel/) baseline them with a justification.
+#
+# PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
+# can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m page_rank_and_tfidf_using_apache_spark_tpu.analysis "$@"

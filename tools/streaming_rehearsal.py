@@ -69,8 +69,25 @@ def run_once(cfg, n_docs: int, docs_per_chunk: int, tokens_per_doc: int,
         cfg, metrics=metrics,
     )
     secs = time.perf_counter() - t0
-    tokens = sum(r["tokens"] for r in metrics.records if r.get("event") == "chunk")
-    return out, secs, tokens, metrics
+    chunk_recs = [r for r in metrics.records if r.get("event") == "chunk"]
+    tokens = sum(r["tokens"] for r in chunk_recs)
+    fin = next((r for r in metrics.records if r.get("event") == "finalize"), None)
+    timing = {
+        "wall_secs": secs,
+        # Ingest-only time: the finalize pass is identical at every
+        # prefetch depth, so including it in serial-vs-pipelined ratios
+        # dilutes the measured overlap toward 1.0 (the round-5 "1.004x"
+        # accounting bug) — pipeline comparisons must use this figure.
+        "ingest_secs": secs - (float(fin["secs"]) if fin else 0.0),
+        "finalize_secs": float(fin["secs"]) if fin else 0.0,
+        # Per-chunk drain (device->host sync) and launch time: the
+        # RTT-bound component, reported so the sync cost is visible
+        # instead of smeared into tokens/sec.
+        "chunk_sync_secs": sum(float(r.get("secs", 0.0)) for r in chunk_recs),
+        "chunk_dispatch_secs": sum(
+            float(r.get("dispatch_secs", 0.0)) for r in chunk_recs),
+    }
+    return out, timing, tokens, metrics
 
 
 def main() -> int:
@@ -104,10 +121,10 @@ def main() -> int:
         small = max(args.docs // 8, 1)
         run_once(TfidfConfig(**base, prefetch=0), small, args.docs_per_chunk,
                  args.tokens_per_doc, args.seed)
-        _, serial_secs, small_tokens, _ = run_once(
+        _, serial_t, small_tokens, _ = run_once(
             TfidfConfig(**base, prefetch=0), small, args.docs_per_chunk,
             args.tokens_per_doc, args.seed)
-        _, pipe_secs, _, _ = run_once(
+        _, pipe_t, _, _ = run_once(
             TfidfConfig(**base, prefetch=2), small, args.docs_per_chunk,
             args.tokens_per_doc, args.seed)
 
@@ -115,23 +132,33 @@ def main() -> int:
         cfg = TfidfConfig(**base, prefetch=2,
                           checkpoint_every=args.checkpoint_every,
                           checkpoint_dir=ckdir)
-        out, secs, tokens, metrics = run_once(
+        out, full_t, tokens, metrics = run_once(
             cfg, args.docs, args.docs_per_chunk, args.tokens_per_doc,
             args.seed)
         n_ckpts = sum(1 for r in metrics.records if r.get("event") == "checkpoint")
 
+    secs = full_t["wall_secs"]
     result = {
         "backend": jax.default_backend(),
         "n_docs": out.n_docs,
         "n_tokens": int(tokens),
         "nnz": out.nnz,
         "wall_secs": round(secs, 2),
+        "ingest_secs": round(full_t["ingest_secs"], 2),
+        "finalize_secs": round(full_t["finalize_secs"], 2),
+        "chunk_sync_secs_total": round(full_t["chunk_sync_secs"], 2),
+        "chunk_dispatch_secs_total": round(full_t["chunk_dispatch_secs"], 2),
         "tokens_per_sec": round(tokens / secs),
+        "tokens_per_sec_ingest": round(tokens / max(full_t["ingest_secs"], 1e-9)),
         "peak_rss_mb": round(peak_rss_mb(), 1),
         "checkpoints_written": n_ckpts,
-        "pipeline_speedup_vs_serial": round(serial_secs / pipe_secs, 3),
-        "serial_secs_eighth_scale": round(serial_secs, 2),
-        "pipelined_secs_eighth_scale": round(pipe_secs, 2),
+        # ingest-only ratio — finalize excluded on both sides (see run_once)
+        "pipeline_speedup_vs_serial": round(
+            serial_t["ingest_secs"] / max(pipe_t["ingest_secs"], 1e-9), 3),
+        "serial_ingest_secs_eighth_scale": round(serial_t["ingest_secs"], 2),
+        "pipelined_ingest_secs_eighth_scale": round(pipe_t["ingest_secs"], 2),
+        "serial_secs_eighth_scale": round(serial_t["wall_secs"], 2),
+        "pipelined_secs_eighth_scale": round(pipe_t["wall_secs"], 2),
         "small_scale_tokens": int(small_tokens),
         "finalize": next((r for r in metrics.records
                           if r.get("event") == "finalize"), None),

@@ -54,8 +54,14 @@ def main() -> int:
             def f(x0, *rest):
                 def body(i, x):
                     out = make_body(x, *rest)
-                    return x + jnp.minimum(
-                        out.ravel()[0].astype(x.dtype), jnp.zeros((), x.dtype))
+                    # Depend on EVERY element: min(|out|) >= 0, so the
+                    # minimum with 0 is exactly 0 and the carry never
+                    # drifts, but XLA cannot DCE any of the measured work.
+                    # (The old out.ravel()[0] chain consumed one element,
+                    # letting XLA slice away the rest — the round-5
+                    # poisoned-cost-model artifact.)
+                    keep = jnp.abs(out).min().astype(x.dtype)
+                    return x + jnp.minimum(keep, jnp.zeros((), x.dtype))
 
                 return lax.fori_loop(0, r, body, x0)
 
