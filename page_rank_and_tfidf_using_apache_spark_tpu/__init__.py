@@ -36,11 +36,15 @@ from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
     TfidfConfig,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.api import pagerank, tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import (
+    ResilienceExhausted,
+)
 
 __version__ = "0.1.0"
 
 __all__ = [
     "PageRankConfig",
+    "ResilienceExhausted",
     "TfidfConfig",
     "pagerank",
     "tfidf",

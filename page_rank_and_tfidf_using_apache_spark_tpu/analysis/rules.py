@@ -89,10 +89,25 @@ def _sync_kind(node: ast.Call, ctx: FileContext, traced: set[str] | None) -> str
     return None
 
 
+# jax.* calls that only query topology/config — they return host objects,
+# never device buffers, so they must not taint values as device-flavored.
+_NON_DISPATCH_JAX = frozenset(
+    {
+        "jax.devices",
+        "jax.local_devices",
+        "jax.device_count",
+        "jax.local_device_count",
+        "jax.default_backend",
+    }
+)
+
+
 def _contains_device_call(expr: ast.AST) -> bool:
     for node in ast.walk(expr):
         if isinstance(node, ast.Call):
             cname = call_name(node)
+            if cname and cname in _NON_DISPATCH_JAX:
+                continue
             if cname and (
                 cname.startswith(_DEVICE_ROOTS) or cname in ("jnp", "jax")
             ):
@@ -546,6 +561,101 @@ def check_dce_timed(ctx: FileContext) -> Iterator[Hit]:
                     f"`{name}` — XLA dead-code-eliminates the rest of the "
                     "measured work; reduce over the whole result (e.g. "
                     "jnp.abs(x).min()) to keep it live",
+                )
+
+
+# --------------------------------------------------------------------------
+# 6. unguarded-host-sync
+# --------------------------------------------------------------------------
+
+# Directory components whose host syncs must route through the resilience
+# executor (retry/backoff, sync deadlines, the CPU degradation ladder, and
+# ResilienceExhausted-with-checkpoint).  resilience/ itself is exempt — it
+# is where the raw calls legitimately live.
+_GUARDED_TREE_DIRS = frozenset({"models", "parallel", "io"})
+_RAW_SYNC_CALLS = frozenset({"jax.device_get", "jax.block_until_ready"})
+_ASARRAY_CALLS = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+)
+_DISPATCH_ROOTS = ("jnp.", "jax.", "lax.")
+
+
+def _device_bound_names(fn: FuncNode | None, ctx: FileContext) -> set[str]:
+    """Names assigned from an expression containing a jnp/jax/lax dispatch
+    call, within ``fn``'s own body (module scope when fn is None) — the
+    light taint that makes ``np.asarray(ranks_dev)`` detectable."""
+    scope: list[ast.stmt]
+    if fn is None:
+        scope = ctx.tree.body
+    else:
+        scope = fn.body if isinstance(fn.body, list) else []
+    out: set[str] = set()
+    for stmt in scope:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not _contains_device_call(node.value):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    out.update(
+                        e.id for e in tgt.elts if isinstance(e, ast.Name)
+                    )
+    return out
+
+
+@rule(
+    "unguarded-host-sync",
+    "raw jax.device_get / .block_until_ready() / np.asarray(device value) "
+    "in models/, parallel/ or io/ — host syncs there must route through "
+    "resilience.executor so retries, sync deadlines and the degradation "
+    "ladder apply (ratchet stays at zero: migrate, don't baseline)",
+)
+def check_unguarded_sync(ctx: FileContext) -> Iterator[Hit]:
+    parts = ctx.relpath.split("/")
+    if not (set(parts[:-1]) & _GUARDED_TREE_DIRS):
+        return
+    taint_cache: dict[FuncNode | None, set[str]] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        if cname in _RAW_SYNC_CALLS:
+            yield (
+                node,
+                f"raw {cname} outside the resilience executor — use "
+                "resilience.executor.device_get / .block_until_ready (or "
+                "run_guarded) so retry, sync-deadline and degradation apply",
+            )
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"
+            and not node.args
+        ):
+            yield (
+                node,
+                "raw .block_until_ready() outside the resilience executor "
+                "— use resilience.executor.block_until_ready so a hung "
+                "fence hits the sync deadline instead of wedging the run",
+            )
+            continue
+        if cname in _ASARRAY_CALLS and len(node.args) == 1:
+            arg = node.args[0]
+            devicey = _contains_device_call(arg)
+            if not devicey and isinstance(arg, ast.Name):
+                fn = ctx.enclosing_function(node)
+                if fn not in taint_cache:
+                    taint_cache[fn] = _device_bound_names(fn, ctx)
+                devicey = arg.id in taint_cache[fn]
+            if devicey:
+                yield (
+                    node,
+                    f"{cname} of a device value is a hidden host sync — "
+                    "pull through resilience.executor.device_get so retry, "
+                    "sync-deadline and degradation apply",
                 )
 
 

@@ -22,6 +22,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
     load_corpus_lines,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    resume_point,
     run_tfidf,
     run_tfidf_streaming,
 )
@@ -93,6 +94,21 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
     )
+    # On resume, probe the checkpoint for the restart chunk so the chunker
+    # never materializes the already-ingested prefix on host (chunk-level
+    # resumable streaming: indices stay stable, documents are not re-read).
+    # The checkpoint's ingested doc count rides along so a changed
+    # --chunk-docs is rejected instead of silently skipping the wrong docs.
+    skip, skip_docs = 0, None
+    if args.streaming and args.resume:
+        skip = resume_point(cfg)
+        if skip:
+            from page_rank_and_tfidf_using_apache_spark_tpu.utils import (
+                checkpoint as ckpt,
+            )
+
+            meta = ckpt.peek_meta(ckpt.latest_checkpoint(cfg.checkpoint_dir))
+            skip_docs = int(meta["extra"]["n_docs"])
     with trace(args.profile_dir):
         if args.streaming and args.mesh:
             from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
@@ -100,13 +116,15 @@ def main(argv: list[str] | None = None) -> int:
             )
 
             out = run_tfidf_sharded(
-                iter_corpus_chunks(docs, args.chunk_docs), cfg,
-                n_devices=args.mesh, metrics=metrics, resume=args.resume,
+                iter_corpus_chunks(docs, args.chunk_docs, skip_chunks=skip,
+                                   expect_skipped_docs=skip_docs),
+                cfg, n_devices=args.mesh, metrics=metrics, resume=args.resume,
             )
         elif args.streaming:
             out = run_tfidf_streaming(
-                iter_corpus_chunks(docs, args.chunk_docs), cfg,
-                metrics=metrics, resume=args.resume,
+                iter_corpus_chunks(docs, args.chunk_docs, skip_chunks=skip,
+                                   expect_skipped_docs=skip_docs),
+                cfg, metrics=metrics, resume=args.resume,
             )
         else:
             out = run_tfidf(docs, cfg, metrics=metrics, doc_names=names)

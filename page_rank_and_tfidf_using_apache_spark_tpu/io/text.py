@@ -197,13 +197,67 @@ def iter_corpus_dir(path: str) -> Iterator[str]:
 def iter_corpus_chunks(
     docs: Iterable[str],
     chunk_docs: int,
+    *,
+    skip_chunks: int = 0,
+    expect_skipped_docs: int | None = None,
 ) -> Iterator[list[str]]:
-    """Fixed-size document chunks for streaming ingest (BASELINE.json:11)."""
+    """Fixed-size document chunks for streaming ingest (BASELINE.json:11).
+
+    ``skip_chunks``: the resumable-streaming fast path.  A resuming
+    consumer (models.tfidf ``resume=True``) ignores the first
+    ``resume_point(cfg)`` chunks by *index*, so for those chunks this
+    iterator yields an empty placeholder instead of buffering their
+    documents — chunk indices (and therefore checkpoint bookkeeping) stay
+    stable while the ingested prefix is never materialized on host.
+
+    ``expect_skipped_docs``: the checkpoint's ingested document count.
+    Chunk indices only line up if the corpus is re-chunked identically, so
+    when given, the skipped prefix must cover exactly this many documents
+    — a different ``chunk_docs`` between runs fails loudly here instead of
+    silently re-ingesting (or dropping) documents.
+    """
     buf: list[str] = []
+    pending = 0  # docs counted through the current skipped chunk
+    skipped_docs = 0
+    emitted = 0
     for d in docs:
+        if emitted < skip_chunks:
+            pending += 1
+            skipped_docs += 1
+            if pending == chunk_docs:
+                yield []  # placeholder: keeps downstream chunk indices stable
+                pending = 0
+                emitted += 1
+                if emitted == skip_chunks and (
+                    expect_skipped_docs is not None
+                    and skipped_docs != expect_skipped_docs
+                ):
+                    raise ValueError(
+                        f"resume chunking mismatch: skipping {skip_chunks} "
+                        f"chunk(s) of {chunk_docs} covers {skipped_docs} "
+                        f"documents but the checkpoint ingested "
+                        f"{expect_skipped_docs}; rerun with the original "
+                        "--chunk-docs"
+                    )
+            continue
         buf.append(d)
         if len(buf) == chunk_docs:
             yield buf
             buf = []
-    if buf:
+            emitted += 1
+    # The corpus may legitimately end inside the skipped prefix when the
+    # checkpoint covers a partial final chunk (e.g. a crash after ingest,
+    # during finalize) — only a document-count mismatch is an error.
+    if (
+        emitted < skip_chunks
+        and expect_skipped_docs is not None
+        and skipped_docs != expect_skipped_docs
+    ):
+        raise ValueError(
+            f"resume chunking mismatch: the corpus ended after "
+            f"{skipped_docs} documents, inside the {skip_chunks}-chunk "
+            f"skipped prefix (checkpoint ingested {expect_skipped_docs}); "
+            "the corpus or --chunk-docs changed since the checkpoint"
+        )
+    if buf or pending:
         yield buf

@@ -10,10 +10,12 @@ fixes cannot diverge between the two drivers.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable
 
 import numpy as np
 
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
@@ -76,6 +78,7 @@ def run_segments(
     extract_np: Callable[[object], np.ndarray],
     segments_allowed: bool = True,
     extra_metrics: dict | None = None,
+    make_cpu_invoke: Callable[[PageRankConfig], Callable] | None = None,
 ):
     """Run ``cfg.iterations`` in checkpoint-sized compiled segments.
 
@@ -84,6 +87,16 @@ def run_segments(
     - ``invoke(runner, ranks_dev)`` executes and returns
       ``(ranks_dev, iters_done, delta)`` with a completed host sync.
     - ``extract_np(ranks_dev)`` yields the checkpointable rank array.
+    - ``make_cpu_invoke(seg_cfg)``, when given, builds the degradation-
+      ladder rung: a ``ranks_dev -> (ranks_dev, iters, delta)`` callable
+      re-lowered for the CPU backend, run when on-device retries are
+      exhausted or the device is lost.
+
+    Each segment dispatch runs under the resilience executor: transient
+    failures retry with backoff (the runner is functional, so re-invoking
+    with the same ranks cannot double-apply iterations), persistent ones
+    degrade to CPU, and exhaustion raises ``ResilienceExhausted`` carrying
+    the latest checkpoint under ``cfg.checkpoint_dir``.
 
     Returns ``(ranks_dev, done, last_delta)``.
     """
@@ -92,18 +105,38 @@ def run_segments(
         if (cfg.checkpoint_every > 0 and cfg.tol == 0.0 and segments_allowed)
         else cfg.iterations - start_iter
     )
+    # GRAFT_SYNC_DEADLINE_S guards *host syncs*, whose healthy duration is
+    # bounded; a compiled segment's legitimate runtime scales with its
+    # iteration count, so inheriting the sync deadline here would kill
+    # healthy long segments.  The dispatch site gets its own knob
+    # (GRAFT_STEP_DEADLINE_S, default 0 = no watchdog).
+    policy = dataclasses.replace(
+        rx.RetryPolicy.from_env(),
+        deadline_s=float(os.environ.get("GRAFT_STEP_DEADLINE_S", 0.0)),
+    )
     runners: dict[int, Callable] = {}
+    cpu_invokes: dict[int, Callable] = {}
     done = start_iter
     last_delta = float("inf")
     while done < cfg.iterations:
         todo = min(segment, cfg.iterations - done)
+        seg_cfg = dataclasses.replace(
+            cfg, iterations=todo, checkpoint_every=0, checkpoint_dir=None
+        )
         if todo not in runners:
-            seg_cfg = dataclasses.replace(
-                cfg, iterations=todo, checkpoint_every=0, checkpoint_dir=None
-            )
             runners[todo] = make_runner(seg_cfg)
+        fallback = None
+        if make_cpu_invoke is not None:
+            def fallback(todo=todo, seg_cfg=seg_cfg, rd=ranks_dev):
+                if todo not in cpu_invokes:
+                    cpu_invokes[todo] = make_cpu_invoke(seg_cfg)
+                return cpu_invokes[todo](rd)
         with Timer() as t:
-            ranks_dev, iters, delta = invoke(runners[todo], ranks_dev)
+            ranks_dev, iters, delta = rx.run_guarded(
+                lambda r=runners[todo], rd=ranks_dev: invoke(r, rd),
+                site="pagerank_step", policy=policy, metrics=metrics,
+                checkpoint_dir=cfg.checkpoint_dir, fallback=fallback,
+            )
         done += int(iters)
         last_delta = float(delta)
         metrics.record(

@@ -24,6 +24,7 @@ import numpy as np
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
 from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import config
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
@@ -66,13 +67,45 @@ def run_pagerank(
         delta = float(delta)  # scalar fetch is the only reliable device sync
         return rd, iters, delta
 
+    def make_cpu_invoke(seg_cfg):
+        """Degradation-ladder rung (resilience/executor.py): re-lower the
+        segment for the CPU backend and run it there.  The graph is re-put
+        from host state — the device copy may be gone with the device —
+        and the live ranks are pulled through the guarded executor (the
+        pull itself can hang on a dead tunnel)."""
+        runner = make(n, seg_cfg)
+
+        def cpu_invoke(rd):
+            cpu = jax.devices("cpu")[0]
+            with jax.default_device(cpu):
+                dg_cpu = ops.put_graph(graph, cfg.dtype)
+                e_cpu = jax.device_put(
+                    rx.device_get(e, site="pagerank_cpu_pull"), cpu
+                )
+                rd_cpu = jax.device_put(
+                    rx.device_get(rd, site="pagerank_cpu_pull"), cpu
+                )
+                out, iters, delta = runner(dg_cpu, rd_cpu, e_cpu)
+                delta = float(delta)
+            return out, iters, delta
+
+        return cpu_invoke
+
     ranks_dev, done, last_delta = driver.run_segments(
         cfg, metrics, ranks_dev, start_iter,
         make_runner=lambda seg_cfg: make(n, seg_cfg),
         invoke=invoke,
-        extract_np=np.asarray,
+        extract_np=lambda rd: rx.device_get(
+            rd, site="pagerank_ckpt_pull", metrics=metrics,
+            checkpoint_dir=cfg.checkpoint_dir,
+        ),
         segments_allowed=not cfg.spark_exact,
+        make_cpu_invoke=make_cpu_invoke,
+    )
+    ranks_np = rx.device_get(
+        ranks_dev, site="pagerank_result_pull", metrics=metrics,
+        checkpoint_dir=cfg.checkpoint_dir,
     )
     return PageRankResult(
-        ranks=np.asarray(ranks_dev), iterations=done, l1_delta=last_delta, metrics=metrics
+        ranks=ranks_np, iterations=done, l1_delta=last_delta, metrics=metrics
     )

@@ -20,6 +20,7 @@ import collections
 import dataclasses
 import queue
 import threading
+import time
 from typing import Iterable, Iterator, Sequence
 
 import jax
@@ -28,6 +29,7 @@ import numpy as np
 
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import profiling
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, TfMode, ensure_dtype_support
@@ -91,7 +93,7 @@ def run_tfidf(
             idf_mode=cfg.idf_mode,
             l2_normalize=cfg.l2_normalize,
         )
-        jax.block_until_ready(result)
+        rx.block_until_ready(result, site="tfidf_batch_sync", metrics=metrics)
     n_pairs = int(result.n_pairs)
     metrics.record(
         event="pipeline", pairs=n_pairs, secs=t_dev.elapsed,
@@ -127,52 +129,85 @@ def grow_chunk_cap(
     return cap, changed
 
 
-def resume_ingest(
-    cfg: TfidfConfig, metrics: MetricsRecorder
-) -> tuple[int, np.ndarray, list, list, int]:
-    """Load the latest ingest checkpoint (streaming and sharded paths share
-    the format).  Returns ``(chunk_index, df_total, parts, doc_length_parts,
-    n_docs)`` — zeros/empties when no checkpoint exists."""
+@dataclasses.dataclass
+class IngestState:
+    """Accumulated streaming-ingest state, shared by the streaming and
+    sharded paths: exactly what a per-chunk checkpoint snapshots, so a
+    killed run resumes at the first unprocessed chunk with zero rework.
+
+    ``ingest_secs`` is cumulative wall time *as of the last checkpoint*,
+    carried across resumes — it is what makes a partial run's tokens/sec a
+    real, comparable metric (bench.py's ``"partial": true`` record).
+    """
+
+    df_total: np.ndarray
+    chunk_index: int = 0  # chunks fully ingested (== next chunk to process)
+    n_docs: int = 0
+    n_tokens: int = 0
+    ingest_secs: float = 0.0
+    parts: list = dataclasses.field(default_factory=list)  # (doc, term, count)
+    doc_length_parts: list = dataclasses.field(default_factory=list)
+
+
+def resume_point(cfg: TfidfConfig) -> int:
+    """Chunk index a ``resume=True`` run will start at (0 = from scratch)
+    — cheap (reads only checkpoint metadata), so callers that can seek
+    their corpus source may skip materializing the ingested prefix
+    (io.text.iter_corpus_chunks ``skip_chunks=``)."""
     if not cfg.checkpoint_dir:
-        raise ValueError("resume=True requires checkpoint_dir")
-    df_total = np.zeros(cfg.vocab_size, cfg.dtype)
+        return 0
     latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
     if latest is None:
-        return 0, df_total, [], [], 0
+        return 0
+    return int(ckpt.peek_meta(latest)["step"])
+
+
+def resume_ingest(cfg: TfidfConfig, metrics: MetricsRecorder) -> IngestState:
+    """Load the latest ingest checkpoint (streaming and sharded paths share
+    the format); a fresh zero state when no checkpoint exists."""
+    if not cfg.checkpoint_dir:
+        raise ValueError("resume=True requires checkpoint_dir")
+    fresh = IngestState(df_total=np.zeros(cfg.vocab_size, cfg.dtype))
+    latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+    if latest is None:
+        return fresh
     chunk_index, arrays, extra = ckpt.load_checkpoint(latest, cfg.config_hash())
-    n_docs = int(extra["n_docs"])
-    parts = [(arrays["doc"], arrays["term"], arrays["count"])]
-    doc_length_parts = [arrays["doc_lengths"]]
-    metrics.record(event="resume", path=latest, chunk=chunk_index, docs=n_docs)
-    return chunk_index, arrays["df"], parts, doc_length_parts, n_docs
+    st = IngestState(
+        df_total=arrays["df"],
+        chunk_index=int(chunk_index),
+        n_docs=int(extra["n_docs"]),
+        n_tokens=int(extra.get("n_tokens", 0)),
+        ingest_secs=float(extra.get("ingest_secs", 0.0)),
+        parts=[(arrays["doc"], arrays["term"], arrays["count"])],
+        doc_length_parts=[arrays["doc_lengths"]],
+    )
+    metrics.record(event="resume", path=latest, chunk=st.chunk_index, docs=st.n_docs)
+    return st
 
 
 def save_ingest_checkpoint(
-    cfg: TfidfConfig,
-    metrics: MetricsRecorder,
-    chunk_index: int,
-    df_total: np.ndarray,
-    parts: list,
-    doc_length_parts: list,
-    n_docs: int,
-) -> tuple[list, list]:
-    """Snapshot accumulated ingest state; returns the (compacted) part
-    lists so callers keep host memory flat across checkpoints."""
-    doc_a, term_a, count_a = (np.concatenate(x) for x in zip(*parts))
-    parts = [(doc_a, term_a, count_a)]
-    doc_length_parts = [np.concatenate(doc_length_parts)]
+    cfg: TfidfConfig, metrics: MetricsRecorder, st: IngestState
+) -> None:
+    """Snapshot accumulated ingest state, compacting the part lists in
+    place so host memory stays flat across checkpoints."""
+    doc_a, term_a, count_a = (np.concatenate(x) for x in zip(*st.parts))
+    st.parts = [(doc_a, term_a, count_a)]
+    st.doc_length_parts = [np.concatenate(st.doc_length_parts)]
     path = ckpt.save_checkpoint(
         cfg.checkpoint_dir,
-        chunk_index,
+        st.chunk_index,
         {
-            "df": df_total, "doc": doc_a, "term": term_a, "count": count_a,
-            "doc_lengths": doc_length_parts[0],
+            "df": st.df_total, "doc": doc_a, "term": term_a, "count": count_a,
+            "doc_lengths": st.doc_length_parts[0],
         },
         cfg.config_hash(),
-        extra={"n_docs": n_docs},
+        extra={
+            "n_docs": st.n_docs,
+            "n_tokens": st.n_tokens,
+            "ingest_secs": round(st.ingest_secs, 3),
+        },
     )
-    metrics.record(event="checkpoint", path=path, chunk=chunk_index)
-    return parts, doc_length_parts
+    metrics.record(event="checkpoint", path=path, chunk=st.chunk_index)
 
 
 # Below this many accumulated pairs the numpy finalize wins (no dispatch /
@@ -182,10 +217,7 @@ DEVICE_FINALIZE_MIN_NNZ = 1 << 20
 
 
 def finalize_tfidf(
-    parts: list,
-    doc_length_parts: list,
-    df_total: np.ndarray,
-    n_docs: int,
+    st: IngestState,
     cfg: TfidfConfig,
     metrics: MetricsRecorder,
 ) -> TfidfOutput:
@@ -194,27 +226,32 @@ def finalize_tfidf(
     numpy; at scale the per-pair math and the per-doc L2 reduction run on
     device (ops.finalize_weights)."""
     dtype = cfg.dtype
-    if not parts:
+    n_docs = st.n_docs
+    df_total = st.df_total
+    if not st.parts:
         z = np.zeros(0, np.int32)
         return TfidfOutput(0, cfg.vocab_bits, z, z, np.zeros(0, dtype),
                            df_total, np.zeros(cfg.vocab_size, dtype), metrics)
 
-    doc_a = np.concatenate([p[0] for p in parts])
-    term_a = np.concatenate([p[1] for p in parts])
-    count_a = np.concatenate([p[2] for p in parts]).astype(dtype)
-    doc_lengths = np.concatenate(doc_length_parts)
+    doc_a = np.concatenate([p[0] for p in st.parts])
+    term_a = np.concatenate([p[1] for p in st.parts])
+    count_a = np.concatenate([p[2] for p in st.parts]).astype(dtype)
+    doc_lengths = np.concatenate(st.doc_length_parts)
 
-    idf = np.asarray(
-        ops.idf_vector(jnp.asarray(df_total), float(max(n_docs, 1)), cfg.idf_mode)
+    idf = rx.device_get(
+        ops.idf_vector(jnp.asarray(df_total), float(max(n_docs, 1)), cfg.idf_mode),
+        site="tfidf_finalize_sync", metrics=metrics,
+        checkpoint_dir=cfg.checkpoint_dir,
     )
     with Timer() as t_fin:
         if doc_a.shape[0] >= DEVICE_FINALIZE_MIN_NNZ:
-            weight = np.asarray(ops.finalize_weights(
+            weight = rx.device_get(ops.finalize_weights(
                 jnp.asarray(doc_a), jnp.asarray(count_a),
                 jnp.asarray(doc_lengths), jnp.asarray(idf[term_a]),
                 n_docs=max(n_docs, 1), tf_mode=cfg.tf_mode,
                 l2_normalize=cfg.l2_normalize,
-            ))
+            ), site="tfidf_finalize_sync", metrics=metrics,
+               checkpoint_dir=cfg.checkpoint_dir)
             where = "device"
         else:
             if cfg.tf_mode is TfMode.RAW:
@@ -264,10 +301,27 @@ def _tokenized_chunks(
     n_docs0: int,
 ) -> Iterator[tuple[int, tio.TokenizedCorpus]]:
     """Tokenize chunks in order, assigning globally unique doc ids;
-    skips the already-ingested prefix on resume."""
+    skips the already-ingested prefix on resume.
+
+    Resume bookkeeping is in chunk *indices*, so a caller re-chunking the
+    corpus differently between runs would silently skip the wrong
+    documents.  When the skipped prefix arrives as real chunks (not the
+    empty placeholders of ``iter_corpus_chunks(skip_chunks=...)``, which
+    validates on its own side), its document count must equal the
+    checkpoint's ``n_docs`` — mismatch fails loudly.
+    """
     n_docs = n_docs0
+    skipped_docs = 0
     for i, docs in enumerate(doc_chunks):
         if i < start_chunk:
+            skipped_docs += len(docs)
+            if i == start_chunk - 1 and skipped_docs not in (0, n_docs0):
+                raise ValueError(
+                    f"resume chunking mismatch: the skipped prefix of "
+                    f"{start_chunk} chunk(s) holds {skipped_docs} documents "
+                    f"but the checkpoint ingested {n_docs0}; rerun with the "
+                    "original chunking (e.g. the same --chunk-docs)"
+                )
             continue  # already ingested before the resume point
         with profiling.annotate("tfidf_tokenize"):
             corpus = tio.tokenize_corpus(
@@ -357,19 +411,15 @@ def run_tfidf_streaming(
     metrics = metrics or MetricsRecorder()
     vocab = cfg.vocab_size
     dtype = cfg.dtype
-
-    df_total = np.zeros(vocab, dtype)
-    n_docs = 0
-    chunk_index = 0
-    parts: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []  # (doc, term, count)
-    doc_length_parts: list[np.ndarray] = []
     cap = cfg.chunk_tokens
 
-    if resume:
-        chunk_index, df_total, parts, doc_length_parts, n_docs = resume_ingest(cfg, metrics)
+    st = (resume_ingest(cfg, metrics) if resume
+          else IngestState(df_total=np.zeros(vocab, dtype)))
+    secs0 = st.ingest_secs
+    run_started = time.perf_counter()
 
     depth = max(int(cfg.prefetch), 0)
-    source = _tokenized_chunks(doc_chunks, cfg, chunk_index, n_docs)
+    source = _tokenized_chunks(doc_chunks, cfg, st.chunk_index, st.n_docs)
     if depth > 0:
         source = _prefetched(source, depth)
 
@@ -378,7 +428,6 @@ def run_tfidf_streaming(
     inflight: collections.deque = collections.deque()
 
     def drain_one():
-        nonlocal df_total, n_docs, chunk_index, parts, doc_length_parts
         i, counts, df_inc, doc_lengths, n_chunk_docs, n_tokens, t = inflight.popleft()
         with Timer() as t_sync, profiling.annotate("tfidf_chunk_sync"):
             # Wait for this chunk's device results with ONE batched
@@ -387,26 +436,32 @@ def run_tfidf_streaming(
             # the df pull) — at ~76 ms tunnel RTT that serialized the
             # whole streaming path (VERDICT.md round 5).  Pulling the
             # padded arrays whole costs a few MB of extra bytes but only
-            # one round-trip; the slice happens on host.
-            h_doc, h_term, h_count, h_n_pairs, h_df = jax.device_get(
-                (counts.doc, counts.term, counts.count, counts.n_pairs, df_inc)
+            # one round-trip; the slice happens on host.  The pull runs
+            # under the resilience executor: a transient failure or blown
+            # sync deadline re-issues the transfer (device buffers are
+            # still live); exhaustion surfaces ResilienceExhausted carrying
+            # the last chunk checkpoint to resume from.
+            h_doc, h_term, h_count, h_n_pairs, h_df = rx.device_get(
+                (counts.doc, counts.term, counts.count, counts.n_pairs, df_inc),
+                site="tfidf_chunk_sync", metrics=metrics,
+                checkpoint_dir=cfg.checkpoint_dir,
             )
             k = int(h_n_pairs)
             # .copy() so parts holds k-sized arrays, not views pinning the
             # whole cap-sized transfer buffer until finalize
-            parts.append((h_doc[:k].copy(), h_term[:k].copy(), h_count[:k].copy()))
-        doc_length_parts.append(doc_lengths)
-        df_total = df_total + h_df.astype(dtype)
-        n_docs += n_chunk_docs
-        chunk_index = i + 1
-        metrics.record(event="chunk", chunk=i, docs=n_docs, tokens=n_tokens,
+            st.parts.append((h_doc[:k].copy(), h_term[:k].copy(), h_count[:k].copy()))
+        st.doc_length_parts.append(doc_lengths)
+        st.df_total = st.df_total + h_df.astype(dtype)
+        st.n_docs += n_chunk_docs
+        st.n_tokens += n_tokens
+        st.chunk_index = i + 1
+        metrics.record(event="chunk", chunk=i, docs=st.n_docs, tokens=n_tokens,
                        pairs=k, dispatch_secs=round(t.elapsed, 6),
                        secs=t_sync.elapsed)
         if (cfg.checkpoint_every > 0 and cfg.checkpoint_dir
-                and chunk_index % cfg.checkpoint_every == 0):
-            parts, doc_length_parts = save_ingest_checkpoint(
-                cfg, metrics, chunk_index, df_total, parts, doc_length_parts, n_docs
-            )
+                and st.chunk_index % cfg.checkpoint_every == 0):
+            st.ingest_secs = secs0 + (time.perf_counter() - run_started)
+            save_ingest_checkpoint(cfg, metrics, st)
 
     for i, corpus in source:
         cap, _ = grow_chunk_cap(corpus.n_tokens, cap, metrics, chunk=i)
@@ -423,4 +478,4 @@ def run_tfidf_streaming(
     while inflight:
         drain_one()
 
-    return finalize_tfidf(parts, doc_length_parts, df_total, n_docs, cfg, metrics)
+    return finalize_tfidf(st, cfg, metrics)

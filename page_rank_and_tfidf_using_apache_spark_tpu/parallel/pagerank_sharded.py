@@ -74,6 +74,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
     NODES_AXIS,
     make_mesh,
 )
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
     DanglingMode,
     PageRankConfig,
@@ -529,14 +530,25 @@ def run_pagerank_sharded(
         delta = float(delta)  # scalar fetch is the only reliable device sync
         return rd, iters, delta
 
+    # No make_cpu_invoke here: the compiled program is welded to the mesh
+    # (collectives over its axis), so there is no single-device re-lowering
+    # to degrade to.  Exhausted retries raise ResilienceExhausted carrying
+    # the checkpoint; rerunning with --mesh 0 --resume IS the degraded path.
     ranks_dev, done, last_delta = driver.run_segments(
         cfg, metrics, ranks_dev, start_iter,
         make_runner=lambda seg_cfg: make_sharded_runner(sg, seg_cfg, mesh),
         invoke=invoke,
-        extract_np=lambda rd: np.asarray(rd)[sg.node_map],
+        extract_np=lambda rd: rx.device_get(
+            rd, site="pagerank_ckpt_pull", metrics=metrics,
+            checkpoint_dir=cfg.checkpoint_dir,
+        )[sg.node_map],
         extra_metrics={"devices": d},
     )
+    ranks_np = rx.device_get(
+        ranks_dev, site="pagerank_result_pull", metrics=metrics,
+        checkpoint_dir=cfg.checkpoint_dir,
+    )
     return PageRankResult(
-        ranks=np.asarray(ranks_dev)[sg.node_map], iterations=done,
+        ranks=ranks_np[sg.node_map], iterations=done,
         l1_delta=last_delta, metrics=metrics,
     )

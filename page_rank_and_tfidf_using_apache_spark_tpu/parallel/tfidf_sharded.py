@@ -17,6 +17,7 @@ compile happens once per (D, cap).
 
 from __future__ import annotations
 
+import time
 from typing import Iterable, Sequence
 
 import jax
@@ -26,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    IngestState,
     TfidfOutput,
     _prefetched,
     _tokenized_chunks,
@@ -35,6 +37,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
     save_ingest_checkpoint,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel import collectives as coll
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, ensure_dtype_support
@@ -92,26 +95,22 @@ def run_tfidf_sharded(
     vocab = cfg.vocab_size
     dtype = cfg.dtype
 
-    df_total = np.zeros(vocab, dtype)
-    n_docs = 0
-    chunk_index = 0  # input chunks fully ingested
-    last_ckpt = 0
-    parts: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
-    doc_length_parts: list[np.ndarray] = []
     cap = cfg.chunk_tokens
     kernel = None
     esh = NamedSharding(mesh, P(axis, None))
 
-    if resume:
-        chunk_index, df_total, parts, doc_length_parts, n_docs = resume_ingest(cfg, metrics)
-        last_ckpt = chunk_index
+    st = (resume_ingest(cfg, metrics) if resume
+          else IngestState(df_total=np.zeros(vocab, dtype)))
+    last_ckpt = st.chunk_index
+    secs0 = st.ingest_secs
+    run_started = time.perf_counter()
 
     # Tokenize on a background thread, up to cfg.prefetch chunks ahead
     # (SURVEY.md §5.7 — same double-buffering as the single-chip streaming
     # path; cfg.prefetch=0 keeps everything on the calling thread).  The
     # consumer pulls d chunks per super-chunk incrementally, so the buffer
     # bound stays exactly what the user asked for.
-    source = _tokenized_chunks(doc_chunks, cfg, chunk_index, n_docs)
+    source = _tokenized_chunks(doc_chunks, cfg, st.chunk_index, st.n_docs)
     if cfg.prefetch > 0:
         source = _prefetched(source, int(cfg.prefetch))
     chunk_iter = iter(source)
@@ -123,7 +122,7 @@ def run_tfidf_sharded(
             if item is None:
                 break
             _, corpus = item
-            n_docs += corpus.n_docs
+            st.n_docs += corpus.n_docs
             group.append(corpus)
         if not group:
             break
@@ -141,7 +140,7 @@ def run_tfidf_sharded(
             doc_ids[i, : c.n_tokens] = c.doc_ids
             term_ids[i, : c.n_tokens] = c.term_ids
             valid[i, : c.n_tokens] = True
-            doc_length_parts.append(c.doc_lengths)
+            st.doc_length_parts.append(c.doc_lengths)
 
         with Timer() as t:
             (c_doc, c_term, c_cnt, c_np, _c_valid), df = kernel(
@@ -152,31 +151,35 @@ def run_tfidf_sharded(
             # One batched device->host pull: a single round-trip per
             # super-chunk instead of a block_until_ready fence plus four
             # separate np.asarray transfers (each paying tunnel RTT).
-            h_doc, h_term, h_cnt, n_pairs, h_df = jax.device_get(  # graftlint: disable=host-sync-in-loop (the one intentional drain per super-chunk)
-                (c_doc, c_term, c_cnt, c_np, df)
+            # Guarded: a transient failure re-issues the pull against the
+            # live buffers; exhaustion carries the chunk checkpoint.
+            h_doc, h_term, h_cnt, n_pairs, h_df = rx.device_get(
+                (c_doc, c_term, c_cnt, c_np, df),
+                site="tfidf_shard_sync", metrics=metrics,
+                checkpoint_dir=cfg.checkpoint_dir,
             )
-        df_total += h_df.astype(dtype)
+        st.df_total = st.df_total + h_df.astype(dtype)
         n_pairs = n_pairs.ravel()
         for i in range(len(group)):
             k = int(n_pairs[i])
             # .copy() so parts holds k-sized arrays, not views pinning the
             # whole (d, cap) transfer buffer until finalize
-            parts.append(
+            st.parts.append(
                 (h_doc[i, :k].copy(), h_term[i, :k].copy(), h_cnt[i, :k].copy())
             )
-        chunk_index += len(group)
+        st.chunk_index += len(group)
+        st.n_tokens += int(sum(c.n_tokens for c in group))
         metrics.record(
-            event="super_chunk", step=step, devices=len(group), docs=n_docs,
+            event="super_chunk", step=step, devices=len(group), docs=st.n_docs,
             tokens=int(sum(c.n_tokens for c in group)), secs=t.elapsed,
         )
         step += 1
         if (
             cfg.checkpoint_every > 0 and cfg.checkpoint_dir
-            and chunk_index - last_ckpt >= cfg.checkpoint_every
+            and st.chunk_index - last_ckpt >= cfg.checkpoint_every
         ):
-            parts, doc_length_parts = save_ingest_checkpoint(
-                cfg, metrics, chunk_index, df_total, parts, doc_length_parts, n_docs
-            )
-            last_ckpt = chunk_index
+            st.ingest_secs = secs0 + (time.perf_counter() - run_started)
+            save_ingest_checkpoint(cfg, metrics, st)
+            last_ckpt = st.chunk_index
 
-    return finalize_tfidf(parts, doc_length_parts, df_total, n_docs, cfg, metrics)
+    return finalize_tfidf(st, cfg, metrics)

@@ -1,0 +1,35 @@
+"""Resilient execution runtime: deterministic fault injection
+(:mod:`.chaos`), a retry/deadline executor with a CPU degradation ladder
+(:mod:`.executor`), and the structured :class:`ResilienceExhausted` that
+hands callers the checkpoint to resume from.  See README "Failure model
+and recovery" for the contract."""
+
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience.chaos import (
+    ChaosError,
+    DeviceLostError,
+    inject,
+    parse_plan,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience.executor import (
+    ResilienceExhausted,
+    RetryPolicy,
+    SyncDeadlineExceeded,
+    block_until_ready,
+    device_get,
+    is_transient,
+    run_guarded,
+)
+
+__all__ = [
+    "ChaosError",
+    "DeviceLostError",
+    "ResilienceExhausted",
+    "RetryPolicy",
+    "SyncDeadlineExceeded",
+    "block_until_ready",
+    "device_get",
+    "inject",
+    "is_transient",
+    "parse_plan",
+    "run_guarded",
+]

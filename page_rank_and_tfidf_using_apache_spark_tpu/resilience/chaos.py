@@ -1,0 +1,183 @@
+"""Deterministic fault injection for the resilience executor.
+
+The production failure modes this repo has actually hit (BENCH_r05: the
+TF-IDF streaming child dying with ``[tfidf] TIMEOUT after 420s`` at chunk
+24, losing all 24 completed chunks) are transient device errors, hung
+host<->device syncs on the relay tunnel, and outright device loss.  None of
+them can be provoked on demand on real hardware, so recovery paths would
+otherwise ship untested.  This shim injects all three deterministically at
+*guarded call sites* (every host-sync / dispatch boundary routed through
+``resilience.executor``), so tier-1 CPU tests can prove end-to-end recovery.
+
+Plan specification — the ``GRAFT_CHAOS`` env var or :func:`inject`::
+
+    GRAFT_CHAOS = "<injection>[;<injection>...]"
+    <injection> = "<site>:<kind>@<when>[:<param>]"
+
+    site   exact site name as passed to executor.run_guarded (e.g.
+           "pagerank_step", "tfidf_chunk_sync"), or "*" for every site
+    kind   fail  - raise ChaosError (a *transient* device error: the
+                   executor retries it with backoff)
+           lost  - raise DeviceLostError (*persistent*: no retry; the
+                   executor degrades to the CPU ladder or raises
+                   ResilienceExhausted)
+           hang  - sleep <param> seconds (default 3600) before returning,
+                   simulating a hung device_get; only a sync deadline
+                   (GRAFT_SYNC_DEADLINE_S) interrupts it
+    when   N     the Nth guarded call at this site (1-based), exactly once
+           N+    every call from the Nth on
+           %K    every Kth call (K, 2K, 3K, ...)
+    param  seconds, for hang
+
+Examples::
+
+    GRAFT_CHAOS="pagerank_step:fail@2"          # one transient mid-run blip
+    GRAFT_CHAOS="tfidf_chunk_sync:lost@26"      # kill the 26th chunk drain
+    GRAFT_CHAOS="*:fail@%5"                     # every 5th guarded call
+                                                # fails once (chaos.sh)
+
+Counters are per *actual* site name and live on the installed plan, so one
+plan == one deterministic schedule.  Everything is thread-safe: guarded
+calls may come from the streaming prefetch machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import threading
+import time
+
+
+class ChaosError(RuntimeError):
+    """Injected *transient* device error (stands in for the retryable
+    XlaRuntimeError family: UNAVAILABLE / DEADLINE_EXCEEDED / ...)."""
+
+
+class DeviceLostError(RuntimeError):
+    """Injected *persistent* device loss — retrying on the same device
+    cannot help; only degradation or restart-from-snapshot can."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Injection:
+    site: str  # exact site name or "*"
+    kind: str  # "fail" | "lost" | "hang"
+    when: str  # "N" | "N+" | "%K"
+    param: float  # seconds, for hang
+
+    def matches(self, site: str, count: int) -> bool:
+        if self.site != "*" and self.site != site:
+            return False
+        w = self.when
+        if w.startswith("%"):
+            k = int(w[1:])
+            return k > 0 and count % k == 0
+        if w.endswith("+"):
+            return count >= int(w[:-1])
+        return count == int(w)
+
+
+def parse_plan(spec: str) -> tuple[Injection, ...]:
+    """Parse a GRAFT_CHAOS spec string; raises ValueError on bad syntax."""
+    out: list[Injection] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(f"bad chaos injection {raw!r}: want site:kind@when[:param]")
+        site, action = parts[0], parts[1]
+        if "@" not in action:
+            raise ValueError(f"bad chaos injection {raw!r}: missing @when")
+        kind, when = action.split("@", 1)
+        if kind not in ("fail", "lost", "hang"):
+            raise ValueError(f"bad chaos kind {kind!r} in {raw!r}")
+        m = re.fullmatch(r"%(\d+)|(\d+)\+?", when)
+        if m is None or int(m.group(1) or m.group(2)) < 1:
+            raise ValueError(f"bad chaos schedule {when!r} in {raw!r}")
+        param = float(parts[2]) if len(parts) == 3 else 3600.0
+        out.append(Injection(site=site, kind=kind, when=when, param=param))
+    return tuple(out)
+
+
+class ChaosPlan:
+    """An installed injection schedule with per-site call counters."""
+
+    def __init__(self, injections: tuple[Injection, ...]):
+        self.injections = injections
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def call_count(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def on_call(self, site: str) -> None:
+        """Record one guarded call at ``site`` and apply any matching
+        injection (first match wins)."""
+        with self._lock:
+            count = self._counts.get(site, 0) + 1
+            self._counts[site] = count
+        for inj in self.injections:
+            if not inj.matches(site, count):
+                continue
+            if inj.kind == "hang":
+                time.sleep(inj.param)
+                return
+            if inj.kind == "lost":
+                raise DeviceLostError(
+                    f"chaos: device lost at {site} call #{count}"
+                )
+            raise ChaosError(f"chaos: transient failure at {site} call #{count}")
+
+
+# The active plan: an explicit inject() context overrides the env plan.
+_lock = threading.Lock()
+_installed: ChaosPlan | None = None
+_env_cache: tuple[str | None, ChaosPlan | None] = (None, None)
+
+
+def active() -> ChaosPlan | None:
+    """The currently active plan: an :func:`inject` context if one is
+    installed, else a (cached) plan parsed from ``GRAFT_CHAOS``."""
+    global _env_cache
+    with _lock:
+        if _installed is not None:
+            return _installed
+        spec = os.environ.get("GRAFT_CHAOS") or None
+        if spec != _env_cache[0]:
+            plan = ChaosPlan(parse_plan(spec)) if spec else None
+            _env_cache = (spec, plan)
+        return _env_cache[1]
+
+
+def on_call(site: str) -> None:
+    """Hook for the executor: count this guarded call and maybe inject."""
+    plan = active()
+    if plan is not None:
+        plan.on_call(site)
+
+
+class inject:
+    """Context manager installing a chaos plan for the enclosed block,
+    overriding any GRAFT_CHAOS env plan.  Returns the plan so tests can
+    read call counters afterwards."""
+
+    def __init__(self, spec: str):
+        self.plan = ChaosPlan(parse_plan(spec))
+        self._prev: ChaosPlan | None = None
+
+    def __enter__(self) -> ChaosPlan:
+        global _installed
+        with _lock:
+            self._prev = _installed
+            _installed = self.plan
+        return self.plan
+
+    def __exit__(self, *exc: object) -> None:
+        global _installed
+        with _lock:
+            _installed = self._prev

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import tempfile
 from typing import Any
 
@@ -22,6 +23,7 @@ import numpy as np
 
 
 _META_KEY = "__ckpt_meta__"
+_CKPT_RE = re.compile(r"^ckpt_(\d{8})\.npz$")
 
 
 def save_checkpoint(
@@ -30,8 +32,14 @@ def save_checkpoint(
     arrays: dict[str, np.ndarray],
     config_hash: str,
     extra: dict[str, Any] | None = None,
+    keep: int | None = None,
 ) -> str:
-    """Atomically write ``step``'s state; returns the checkpoint path."""
+    """Atomically write ``step``'s state; returns the checkpoint path.
+
+    ``keep`` bounds how many ``.npz`` snapshots stay on disk (oldest pruned
+    after the LATEST pointer flips); None reads ``GRAFT_CKPT_KEEP``
+    (default 8), and 0 keeps everything.
+    """
     os.makedirs(directory, exist_ok=True)
     meta = {"step": int(step), "config_hash": config_hash, "extra": extra or {}}
     path = os.path.join(directory, f"ckpt_{step:08d}.npz")
@@ -47,13 +55,57 @@ def save_checkpoint(
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    # "latest" pointer, also atomic.
+    # "latest" pointer, also atomic — and with the same tmp hygiene as the
+    # payload write: a failure between mkstemp and replace must not leak
+    # the tempfile (it previously did).
     ptr = os.path.join(directory, "LATEST")
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    with os.fdopen(fd, "w") as f:
-        f.write(os.path.basename(path))
-    os.replace(tmp, ptr)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(os.path.basename(path))
+        os.replace(tmp, ptr)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    if keep is None:
+        keep = int(os.environ.get("GRAFT_CKPT_KEEP", 8))
+    if keep > 0:
+        gc_checkpoints(directory, keep=keep)
     return path
+
+
+def gc_checkpoints(directory: str, keep: int) -> list[str]:
+    """Delete all but the newest ``keep`` snapshots (by step number).
+
+    The file the LATEST pointer names is always kept, whatever its step —
+    a resumable run must never have its pointer dangling.  Returns the
+    deleted paths (for logging/tests).
+    """
+    if keep <= 0:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    snaps = sorted(
+        (int(m.group(1)), n) for n in names if (m := _CKPT_RE.match(n))
+    )
+    pinned: str | None = None
+    ptr = os.path.join(directory, "LATEST")
+    if os.path.exists(ptr):
+        with open(ptr) as f:
+            pinned = f.read().strip()
+    deleted: list[str] = []
+    for _, name in snaps[:-keep] if len(snaps) > keep else []:
+        if name == pinned:
+            continue
+        path = os.path.join(directory, name)
+        try:
+            os.unlink(path)
+            deleted.append(path)
+        except FileNotFoundError:
+            pass  # concurrent gc — already gone
+    return deleted
 
 
 def latest_checkpoint(directory: str) -> str | None:
@@ -64,6 +116,15 @@ def latest_checkpoint(directory: str) -> str | None:
         name = f.read().strip()
     path = os.path.join(directory, name)
     return path if os.path.exists(path) else None
+
+
+def peek_meta(path: str) -> dict[str, Any]:
+    """Read only a checkpoint's metadata ``{step, config_hash, extra}`` —
+    npz members load lazily, so this never touches the state arrays.
+    Cheap enough for resume-point probing and for bench.py's partial-run
+    accounting."""
+    with np.load(path) as z:
+        return json.loads(bytes(z[_META_KEY]).decode())
 
 
 def load_checkpoint(

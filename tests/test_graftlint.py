@@ -359,6 +359,77 @@ def test_dce_timed_region_suppressed(tmp_path):
     assert "dce-timed-region" not in rules_hit(lint_snippet(tmp_path, DCE_SUPPRESSED))
 
 
+# --------------------------------------------------------------- rule 6
+
+
+UNGUARDED_SYNC_TP = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def drain(counts):
+    jax.block_until_ready(counts)          # raw fence
+    host = jax.device_get(counts)          # raw pull
+    y = jnp.dot(host, host)
+    arr = np.asarray(y)                    # hidden sync: y is device-bound
+    return host, arr
+"""
+
+UNGUARDED_SYNC_TN = """
+import numpy as np
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+
+def drain(counts, cfg, metrics):
+    host = rx.device_get(counts, site="tfidf_chunk_sync", metrics=metrics,
+                         checkpoint_dir=cfg.checkpoint_dir)  # guarded
+    lengths = np.asarray([1, 2, 3])        # host data: no sync
+    return host, lengths
+"""
+
+UNGUARDED_SYNC_SUPPRESSED = """
+import jax
+
+def drain(counts):
+    return jax.device_get(counts)  # graftlint: disable=unguarded-host-sync (bootstrap path, no executor yet)
+"""
+
+
+def lint_models_snippet(tmp_path: Path, code: str):
+    """Write the snippet under a models/ subtree: unguarded-host-sync only
+    patrols the models/, parallel/ and io/ directories."""
+    d = tmp_path / "models"
+    d.mkdir(exist_ok=True)
+    f = d / "snippet.py"
+    f.write_text(code)
+    return lint_file(f, tmp_path)
+
+
+def test_unguarded_sync_true_positive(tmp_path):
+    findings = [f for f in lint_models_snippet(tmp_path, UNGUARDED_SYNC_TP)
+                if f.rule == "unguarded-host-sync"]
+    assert len(findings) >= 3  # fence + pull + device-bound asarray
+
+
+def test_unguarded_sync_true_negative(tmp_path):
+    assert "unguarded-host-sync" not in rules_hit(
+        lint_models_snippet(tmp_path, UNGUARDED_SYNC_TN)
+    )
+
+
+def test_unguarded_sync_ignores_other_directories(tmp_path):
+    """The same raw syncs are legal outside models//parallel//io/ (e.g.
+    ops/ pipelines, tools/) — this rule is about the execution paths."""
+    f = tmp_path / "snippet.py"
+    f.write_text(UNGUARDED_SYNC_TP)
+    assert "unguarded-host-sync" not in rules_hit(lint_file(f, tmp_path))
+
+
+def test_unguarded_sync_suppressed(tmp_path):
+    assert "unguarded-host-sync" not in rules_hit(
+        lint_models_snippet(tmp_path, UNGUARDED_SYNC_SUPPRESSED)
+    )
+
+
 # ----------------------------------------------------- engine machinery
 
 
@@ -393,6 +464,7 @@ def test_every_rule_has_summary():
         "dtype-drift",
         "nonstatic-shape",
         "dce-timed-region",
+        "unguarded-host-sync",
     }
     for rule in RULES.values():
         assert rule.summary

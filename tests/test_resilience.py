@@ -1,0 +1,442 @@
+"""Resilience runtime tests (ISSUE 2): deterministic fault injection
+(resilience/chaos.py) driving the retry/deadline executor
+(resilience/executor.py) and the resumable execution paths end to end.
+
+The acceptance bar: with GRAFT_CHAOS-style injection mid-run, PageRank
+resumes from checkpoint and converges to the same ranks as an
+uninterrupted run; streaming TF-IDF resume reprocesses ZERO completed
+chunks (asserted via chunk-event counts); bench.py under a forced tfidf
+timeout emits a ``"partial": true`` record with nonzero chunks completed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu import (
+    PageRankConfig,
+    ResilienceExhausted,
+    TfidfConfig,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.io import synthetic_powerlaw
+from page_rank_and_tfidf_using_apache_spark_tpu.io.text import iter_corpus_chunks
+from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import run_pagerank
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    resume_point,
+    run_tfidf_streaming,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ------------------------------------------------------------- chaos layer
+
+
+def test_parse_plan_schedules():
+    plan = chaos.parse_plan("a:fail@3; b:lost@2+ ; c:hang@%4:0.5")
+    assert [i.kind for i in plan] == ["fail", "lost", "hang"]
+    a, b, c = plan
+    assert [a.matches("a", n) for n in (1, 2, 3, 4)] == [False, False, True, False]
+    assert [b.matches("b", n) for n in (1, 2, 3)] == [False, True, True]
+    assert [c.matches("c", n) for n in (3, 4, 8, 9)] == [False, True, True, False]
+    assert c.param == 0.5
+    assert not a.matches("other_site", 3)
+
+
+def test_parse_plan_wildcard_site():
+    (inj,) = chaos.parse_plan("*:fail@%2")
+    assert inj.matches("anything", 2) and not inj.matches("anything", 3)
+
+
+@pytest.mark.parametrize(
+    "bad", ["nosep", "a:frob@1", "a:fail", "a:fail@0", "a:fail@x",
+            "a:fail@%0", "a:fail@5++", "a:fail@%5+", "a:fail@+5"]
+)
+def test_parse_plan_rejects(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_plan(bad)
+
+
+def test_inject_overrides_env_and_counts(monkeypatch):
+    monkeypatch.setenv("GRAFT_CHAOS", "s:lost@1")  # would fail immediately
+    with chaos.inject("s:fail@2") as plan:
+        chaos.on_call("s")  # call 1: no injection under the override
+        with pytest.raises(chaos.ChaosError):
+            chaos.on_call("s")  # call 2: injected transient
+        assert plan.call_count("s") == 2
+    # env plan active again after the context exits
+    with pytest.raises(chaos.DeviceLostError):
+        chaos.on_call("s")
+
+
+# ---------------------------------------------------------------- executor
+
+
+def test_backoff_deterministic_and_bounded():
+    pol = rx.RetryPolicy(backoff_base_s=0.05, backoff_max_s=0.2)
+    d1 = rx.backoff_delay("site", 1, pol)
+    assert d1 == rx.backoff_delay("site", 1, pol)  # deterministic
+    assert 0.05 <= d1 < 0.075
+    assert rx.backoff_delay("site", 10, pol) == 0.2  # capped
+
+
+def test_transient_classification():
+    assert rx.is_transient(chaos.ChaosError("x"))
+    assert rx.is_transient(rx.SyncDeadlineExceeded("x"))
+    assert rx.is_transient(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert not rx.is_transient(chaos.DeviceLostError("x"))
+    assert not rx.is_transient(ValueError("shape mismatch"))
+
+
+def test_run_guarded_retries_transients():
+    calls = []
+    pol = rx.RetryPolicy(max_retries=3, backoff_base_s=0.001)
+    m = MetricsRecorder()
+    with chaos.inject("t1:fail@1;t1:fail@2"):
+        out = rx.run_guarded(lambda: calls.append(1) or 42, site="t1",
+                             policy=pol, metrics=m)
+    assert out == 42
+    assert len(calls) == 1  # two injections happened BEFORE fn ran
+    assert sum(r.get("event") == "retry" for r in m.records) == 2
+
+
+def test_run_guarded_persistent_skips_retries_and_uses_fallback():
+    pol = rx.RetryPolicy(max_retries=5, backoff_base_s=0.001)
+    m = MetricsRecorder()
+    with chaos.inject("t2:lost@1+") as plan:
+        out = rx.run_guarded(lambda: 1, site="t2", policy=pol, metrics=m,
+                             fallback=lambda: "degraded")
+    assert out == "degraded"
+    assert plan.call_count("t2") == 1  # no retry spent on a lost device
+    assert any(r.get("event") == "degraded" for r in m.records)
+
+
+def test_run_guarded_exhausted_carries_checkpoint(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, 7, {"x": np.arange(3)}, "h")
+    pol = rx.RetryPolicy(max_retries=1, backoff_base_s=0.001)
+    with chaos.inject("t3:fail@1+"):
+        with pytest.raises(ResilienceExhausted) as ei:
+            rx.run_guarded(lambda: 1, site="t3", policy=pol, checkpoint_dir=d)
+    err = ei.value
+    assert err.site == "t3" and err.attempts == 2
+    assert err.last_checkpoint and err.last_checkpoint.endswith("ckpt_00000007.npz")
+    assert isinstance(err.last_error, chaos.ChaosError)
+
+
+def test_sync_deadline_watchdog_abandons_hung_call():
+    pol = rx.RetryPolicy(max_retries=1, backoff_base_s=0.001, deadline_s=0.15)
+    t0 = time.perf_counter()
+    # call 1 hangs 5s inside the watched thread; the watchdog abandons it
+    # and the retry (call 2, uninjected) succeeds.
+    with chaos.inject("t4:hang@1:5"):
+        out = rx.run_guarded(lambda: "ok", site="t4", policy=pol)
+    assert out == "ok"
+    assert time.perf_counter() - t0 < 2.0  # nowhere near the 5s hang
+
+
+# -------------------------------------------------- checkpoint satellites
+
+
+def test_latest_pointer_write_failure_leaks_no_tmp(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    ckpt.save_checkpoint(d, 1, {"x": np.arange(2)}, "h")
+    real_replace = os.replace
+
+    def failing_replace(src, dst):
+        if dst.endswith("LATEST"):
+            raise OSError("disk full")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(ckpt.os, "replace", failing_replace)
+    with pytest.raises(OSError):
+        ckpt.save_checkpoint(d, 2, {"x": np.arange(2)}, "h")
+    monkeypatch.setattr(ckpt.os, "replace", real_replace)
+    assert not [n for n in os.listdir(d) if n.endswith(".tmp")]
+    # the previous LATEST still resolves (old pointer, old payload intact)
+    step, arrays, _ = ckpt.load_checkpoint(ckpt.latest_checkpoint(d), "h")
+    assert step == 1
+
+
+def test_gc_checkpoints_retention_keeps_latest(tmp_path):
+    d = str(tmp_path)
+    for s in range(6):
+        ckpt.save_checkpoint(d, s, {"x": np.arange(2)}, "h", keep=0)
+    deleted = ckpt.gc_checkpoints(d, keep=2)
+    kept = sorted(n for n in os.listdir(d) if n.endswith(".npz"))
+    assert kept == ["ckpt_00000004.npz", "ckpt_00000005.npz"]
+    assert len(deleted) == 4
+    assert ckpt.latest_checkpoint(d).endswith("ckpt_00000005.npz")
+    with pytest.raises(ValueError):
+        ckpt.gc_checkpoints(d, keep=0)
+
+
+def test_save_checkpoint_default_retention(tmp_path, monkeypatch):
+    monkeypatch.setenv("GRAFT_CKPT_KEEP", "3")
+    d = str(tmp_path)
+    for s in range(10):
+        ckpt.save_checkpoint(d, s, {"x": np.arange(2)}, "h")
+    assert sum(n.endswith(".npz") for n in os.listdir(d)) == 3
+
+
+def test_peek_meta_reads_without_arrays(tmp_path):
+    d = str(tmp_path)
+    path = ckpt.save_checkpoint(d, 5, {"x": np.arange(4)}, "hash5",
+                                extra={"n_docs": 9})
+    meta = ckpt.peek_meta(path)
+    assert meta["step"] == 5 and meta["config_hash"] == "hash5"
+    assert meta["extra"] == {"n_docs": 9}
+
+
+# -------------------------------------------------------- io chunk skipping
+
+
+def test_iter_corpus_chunks_skip_prefix_keeps_indices():
+    docs = [f"d{i}" for i in range(10)]
+    plain = list(iter_corpus_chunks(iter(docs), 3))
+    skipped = list(iter_corpus_chunks(iter(docs), 3, skip_chunks=2))
+    assert len(skipped) == len(plain) == 4
+    assert skipped[0] == [] and skipped[1] == []  # placeholders, no strings
+    assert skipped[2:] == plain[2:]
+
+
+def test_iter_corpus_chunks_rejects_rechunked_resume():
+    """Resume bookkeeping is in chunk indices: skipping 2 chunks of 3 docs
+    when the checkpoint ingested 8 means the chunking changed — refuse."""
+    docs = [f"d{i}" for i in range(10)]
+    ok = list(iter_corpus_chunks(iter(docs), 3, skip_chunks=2,
+                                 expect_skipped_docs=6))
+    assert ok[0] == [] and ok[2:] == [["d6", "d7", "d8"], ["d9"]]
+    with pytest.raises(ValueError, match="chunking mismatch"):
+        list(iter_corpus_chunks(iter(docs), 3, skip_chunks=2,
+                                expect_skipped_docs=8))
+    with pytest.raises(ValueError, match="corpus ended"):
+        list(iter_corpus_chunks(iter(docs[:4]), 3, skip_chunks=4,
+                                expect_skipped_docs=12))
+    # A checkpoint covering a partial FINAL chunk is legitimate (crash after
+    # ingest, during finalize): matching doc counts must not raise.
+    tail = list(iter_corpus_chunks(iter(docs), 3, skip_chunks=4,
+                                   expect_skipped_docs=10))
+    assert tail == [[], [], [], []]
+
+
+def test_streaming_resume_rejects_rechunked_corpus(tmp_path):
+    """Model-side guard: feeding a resume run differently-sized real
+    chunks (doc counts that cannot match the checkpoint) fails loudly
+    instead of silently re-ingesting documents."""
+    chunks = _chunks(6, docs_per_chunk=2)
+    cfg = TfidfConfig(vocab_bits=10, prefetch=0, checkpoint_every=1,
+                      checkpoint_dir=str(tmp_path / "ck"))
+    run_tfidf_streaming(chunks[:4], cfg)  # "crash" after 4 chunks / 8 docs
+    docs = [d for c in chunks for d in c]
+    rechunked = [docs[i:i + 3] for i in range(0, len(docs), 3)]  # chunks of 3
+    with pytest.raises(ValueError, match="chunking mismatch"):
+        run_tfidf_streaming(rechunked, cfg, resume=True)
+
+
+# ------------------------------------------- end-to-end recovery: PageRank
+
+
+GRAPH_KW = dict(dangling="redistribute", init="uniform", dtype="float32")
+
+
+def test_pagerank_transient_failure_recovers_identically():
+    """(a) A transient dispatch failure mid-PageRank: the executor retries
+    and the final ranks match an uninterrupted run to f32 tolerance."""
+    g = synthetic_powerlaw(2000, 8000, seed=13)
+    cfg = PageRankConfig(iterations=12, **GRAPH_KW)
+    base = run_pagerank(g, cfg)
+    m = MetricsRecorder()
+    with chaos.inject("pagerank_step:fail@1"):
+        res = run_pagerank(g, cfg, metrics=m)
+    assert any(r.get("event") == "retry" for r in m.records)
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+
+
+def test_pagerank_device_loss_degrades_to_cpu():
+    g = synthetic_powerlaw(500, 2000, seed=3)
+    cfg = PageRankConfig(iterations=8, **GRAPH_KW)
+    base = run_pagerank(g, cfg)
+    m = MetricsRecorder()
+    with chaos.inject("pagerank_step:lost@1+"):
+        res = run_pagerank(g, cfg, metrics=m)
+    assert any(r.get("event") == "degraded" for r in m.records)
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+
+
+def test_pagerank_exhausted_resumes_from_checkpoint(tmp_path):
+    """The full ladder: mid-run device loss with the CPU rung also failing
+    -> ResilienceExhausted carrying the checkpoint -> a resume run (no
+    chaos) converges to the uninterrupted ranks."""
+    g = synthetic_powerlaw(800, 3200, seed=7)
+    base = run_pagerank(g, PageRankConfig(iterations=12, **GRAPH_KW))
+
+    ckdir = str(tmp_path / "ck")
+    cfg = PageRankConfig(iterations=12, checkpoint_every=4,
+                         checkpoint_dir=ckdir, **GRAPH_KW)
+    m = MetricsRecorder()
+    with chaos.inject("pagerank_step:lost@3+;pagerank_cpu_pull:lost@1+"):
+        with pytest.raises(ResilienceExhausted) as ei:
+            run_pagerank(g, cfg, metrics=m)
+    # segments 1 and 2 completed -> checkpoint at iteration 8 survives
+    assert ei.value.last_checkpoint is not None
+    assert ckpt.peek_meta(ei.value.last_checkpoint)["step"] == 8
+
+    m2 = MetricsRecorder()
+    res = run_pagerank(g, cfg, metrics=m2, resume=True)
+    resumed = [r for r in m2.records if r.get("event") == "resume"]
+    assert resumed and resumed[0]["start_iter"] == 8
+    assert res.iterations == 12
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+
+
+def test_pagerank_sharded_exhausted_then_resume(tmp_path):
+    """The sharded path has no CPU rung (the program is welded to the
+    mesh): exhaustion surfaces the checkpoint, and a single-chip resume —
+    the documented degraded path — finishes to the same ranks."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+        run_pagerank_sharded,
+    )
+
+    g = synthetic_powerlaw(600, 2400, seed=11)
+    base = run_pagerank(g, PageRankConfig(iterations=9, **GRAPH_KW))
+    ckdir = str(tmp_path / "ck")
+    cfg = PageRankConfig(iterations=9, checkpoint_every=3,
+                         checkpoint_dir=ckdir, **GRAPH_KW)
+    with chaos.inject("pagerank_step:lost@2+"):
+        with pytest.raises(ResilienceExhausted) as ei:
+            run_pagerank_sharded(g, cfg, n_devices=4)
+    assert ei.value.last_checkpoint is not None
+    res = run_pagerank(g, cfg, resume=True)  # degrade: finish single-chip
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+
+
+# ------------------------------------------- end-to-end recovery: TF-IDF
+
+
+def _chunks(n_chunks: int, docs_per_chunk: int = 2) -> list[list[str]]:
+    docs = [f"tok{i} tok{i % 5} shared word extra{i % 3}"
+            for i in range(n_chunks * docs_per_chunk)]
+    return [docs[i:i + docs_per_chunk]
+            for i in range(0, len(docs), docs_per_chunk)]
+
+
+def test_tfidf_chunk25_failure_resumes_with_zero_reprocessing(tmp_path):
+    """(b) A chunk-25 failure in streaming TF-IDF: chunks 0-24 are not
+    reprocessed (chunk-event counts prove it) and the resumed output
+    matches the uninterrupted run."""
+    chunks = _chunks(26)
+    base_cfg = TfidfConfig(vocab_bits=10, prefetch=0)
+    full = run_tfidf_streaming(chunks, base_cfg)
+
+    cfg = TfidfConfig(vocab_bits=10, prefetch=0, checkpoint_every=1,
+                      checkpoint_dir=str(tmp_path / "ck"))
+    m1 = MetricsRecorder()
+    with chaos.inject("tfidf_chunk_sync:lost@26"):  # the 26th drain = chunk 25
+        with pytest.raises(ResilienceExhausted) as ei:
+            run_tfidf_streaming(chunks, cfg, metrics=m1)
+    done_before = [r["chunk"] for r in m1.records if r.get("event") == "chunk"]
+    assert done_before == list(range(25))  # chunks 0-24 landed, then the kill
+    assert ei.value.last_checkpoint is not None
+    assert ckpt.peek_meta(ei.value.last_checkpoint)["step"] == 25
+    assert resume_point(cfg) == 25
+
+    m2 = MetricsRecorder()
+    res = run_tfidf_streaming(chunks, cfg, metrics=m2, resume=True)
+    done_after = [r["chunk"] for r in m2.records if r.get("event") == "chunk"]
+    assert done_after == [25]  # ZERO completed chunks reprocessed
+    assert res.n_docs == full.n_docs
+    np.testing.assert_allclose(res.to_dense(), full.to_dense(), atol=1e-6)
+
+
+def test_tfidf_transient_chunk_failures_are_invisible(tmp_path):
+    chunks = _chunks(8)
+    full = run_tfidf_streaming(chunks, TfidfConfig(vocab_bits=10, prefetch=0))
+    m = MetricsRecorder()
+    with chaos.inject("tfidf_chunk_sync:fail@%3"):
+        res = run_tfidf_streaming(chunks, TfidfConfig(vocab_bits=10, prefetch=0),
+                                  metrics=m)
+    assert sum(r.get("event") == "retry" for r in m.records) >= 2
+    np.testing.assert_allclose(res.to_dense(), full.to_dense(), atol=1e-6)
+
+
+def test_tfidf_sharded_loss_then_resume(tmp_path):
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+        run_tfidf_sharded,
+    )
+
+    chunks = _chunks(12)
+    base = run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10),
+                             n_devices=4)
+    cfg = TfidfConfig(vocab_bits=10, checkpoint_every=4,
+                      checkpoint_dir=str(tmp_path / "ck"))
+    with chaos.inject("tfidf_shard_sync:lost@2+"):
+        with pytest.raises(ResilienceExhausted) as ei:
+            run_tfidf_sharded(iter(chunks), cfg, n_devices=4)
+    assert ei.value.last_checkpoint is not None
+    res = run_tfidf_sharded(iter(chunks), cfg, n_devices=4, resume=True)
+    assert res.n_docs == base.n_docs
+    np.testing.assert_allclose(res.to_dense(), base.to_dense(), atol=1e-6)
+
+
+def test_tfidf_checkpoint_carries_throughput_accounting(tmp_path):
+    cfg = TfidfConfig(vocab_bits=10, prefetch=0, checkpoint_every=2,
+                      checkpoint_dir=str(tmp_path / "ck"))
+    run_tfidf_streaming(_chunks(6), cfg)
+    meta = ckpt.peek_meta(ckpt.latest_checkpoint(cfg.checkpoint_dir))
+    assert meta["extra"]["n_docs"] == 12
+    assert meta["extra"]["n_tokens"] > 0
+    assert meta["extra"]["ingest_secs"] > 0
+
+
+# ----------------------------------------------- bench.py partial record
+
+
+def test_bench_forced_tfidf_timeout_emits_partial_record():
+    """Acceptance: bench.py under a forced tfidf timeout (chaos hangs every
+    chunk drain from the 8th on; the child can never finish) emits a
+    ``"partial": true`` record with nonzero chunks completed — instead of
+    BENCH_r05's bare TIMEOUT log line and a discarded run."""
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_NODES="400", BENCH_EDGES="1600", BENCH_ITERS="2",
+        BENCH_IMPLS="segment", BENCH_IMPL_TIMEOUT_S="180",
+        BENCH_PROBE_TIMEOUT_S="90",
+        BENCH_TFIDF_DOCS="256", BENCH_TFIDF_TOKENS_PER_DOC="30",
+        BENCH_TFIDF_CHUNK_DOCS="16",  # -> 16 streaming chunks
+        BENCH_TFIDF_CKPT_EVERY="1",   # chunk-granular resume for this test
+        BENCH_TFIDF_TIMEOUT_S="30", BENCH_TFIDF_RETRIES="1",
+        # every chunk drain from the 8th on hangs "forever": the child
+        # checkpoints 7 chunks then wedges; the resume retry checkpoints 7
+        # more from chunk 7 and wedges again
+        GRAFT_CHAOS="tfidf_chunk_sync:hang@8+:600",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    tfidf = record["extra"].get("tfidf")
+    assert tfidf, record
+    assert tfidf["partial"] is True
+    assert tfidf["chunks_completed"] > 0
+    assert tfidf["tokens_completed"] > 0
+    assert tfidf["stream_tokens_per_sec_so_far"] > 0
+    # the resume retry made it strictly past the first child's wedge point
+    assert tfidf["chunks_completed"] >= 8

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Chaos gate: run the tier-1 suite under an aggressive fault-injection
+# profile — every 5th guarded call (dispatch or host sync) at EVERY site
+# raises a transient device error, and a generous sync deadline arms the
+# watchdog thread on each guarded call.  The suite must pass unchanged:
+# the resilience executor's retries make injected transients invisible to
+# callers, which is exactly the property this gate pins.
+#
+# Tests that install their own chaos plan (resilience.chaos.inject) are
+# unaffected: an explicit plan overrides the GRAFT_CHAOS env plan.
+#
+# PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
+# can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env -u PALLAS_AXON_POOL_IPS \
+    JAX_PLATFORMS=cpu \
+    GRAFT_CHAOS='*:fail@%5' \
+    GRAFT_RETRY_MAX=4 \
+    GRAFT_BACKOFF_BASE_S=0.01 \
+    GRAFT_SYNC_DEADLINE_S=60 \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly "$@"
