@@ -305,6 +305,27 @@ def _read_ckpt_meta(ck_dir: str) -> dict | None:
         return None
 
 
+def _lint_clean() -> bool | None:
+    """Run the graftlint gate (both tiers, CPU-only subprocess) and report
+    its verdict, so every BENCH_*.json records whether the measured tree
+    passed static analysis.  None = the gate itself could not run (never
+    blocks the bench)."""
+    lint_sh = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tools", "lint.sh")
+    try:
+        proc = subprocess.run(
+            [lint_sh], capture_output=True, text=True, timeout=180,
+        )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        log(f"[lint] gate unavailable: {exc}")
+        return None
+    clean = proc.returncode == 0
+    log(f"[lint] {'clean' if clean else 'FINDINGS'} (rc={proc.returncode})")
+    if not clean:
+        sys.stderr.write(proc.stdout[-2000:])
+    return clean
+
+
 def _run_child(mode: str, timeout_s: int, env: dict) -> dict | None:
     """Run ``bench.py --<mode>`` in a subprocess; parse its last JSON line."""
     t0 = time.perf_counter()
@@ -391,7 +412,19 @@ def _main(graph_cache: str) -> int:
         # jax resolved to CPU on its own — no TPU plugin present
         log("backend resolved to cpu (no TPU plugin)")
     child_env = dict(os.environ)
-    if not tpu_alive:
+    if tpu_alive:
+        # Arm the resilience watchdog in every TPU child (ROADMAP PR-2
+        # leftover): a hung host sync on the relay tunnel then surfaces as
+        # a retryable SyncDeadlineExceeded inside the child instead of
+        # wedging it until the parent's 420 s kill.  Healthy syncs at this
+        # scale finish in well under a second; the default leaves >100x
+        # headroom.  Override with BENCH_SYNC_DEADLINE_S (0 disables); an
+        # explicit GRAFT_SYNC_DEADLINE_S in the parent env wins outright.
+        child_env.setdefault(
+            "GRAFT_SYNC_DEADLINE_S",
+            os.environ.get("BENCH_SYNC_DEADLINE_S", "120"),
+        )
+    else:
         log(f"TPU UNREACHABLE (probe={probe_out}); falling back to JAX-CPU "
             "for all measurements")
         # Stripping PALLAS_AXON_POOL_IPS makes the axon sitecustomize skip
@@ -506,7 +539,8 @@ def _main(graph_cache: str) -> int:
     # --- sklearn anchor for TF-IDF (same corpus would be ideal but costs
     # parent time; a fixed-rate anchor is recorded by tools/ when needed) ---
     extra: dict = {"tpu_unreachable": not tpu_alive, "backend": backend_used,
-                   "cpu_anchor_ips": round(cpu_ips, 2)}
+                   "cpu_anchor_ips": round(cpu_ips, 2),
+                   "lint_clean": _lint_clean()}
     if tfidf_out:
         extra["tfidf_batch_tokens_per_sec"] = round(
             tfidf_out.get("batch_tokens_per_sec", 0.0))

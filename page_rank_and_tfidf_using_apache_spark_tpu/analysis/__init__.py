@@ -1,20 +1,30 @@
-"""graftlint — JAX/TPU-aware static analysis for this codebase (ISSUE 1).
+"""graftlint — JAX/TPU-aware static analysis for this codebase (ISSUEs 1, 3).
 
-The jit-compiled cores rest on invariants nothing else enforces: hot loops
-stay inside one compiled program (no host round-trips), control flow on
-traced values goes through lax combinators, dtypes are pinned (no float64
-on TPU), shapes are static, and benchmarks fence what they time so XLA
-cannot dead-code-eliminate the measured work.  ``analysis`` machine-checks
-those invariants over the package, ``tools/`` and ``bench.py`` with a
-ratchet baseline (``analysis/baseline.json``) so existing debt is frozen
-and new violations fail CI (``tools/lint.sh``, ``tests/test_graftlint.py``).
+Two tiers over one ratchet baseline:
 
-Stdlib-only on purpose: the linter must keep working when jax is broken.
+- **Tier 1 (lexical, rules.py)**: stdlib-only AST rules over the package,
+  ``tools/`` and ``bench.py`` — hot loops stay inside one compiled program
+  (no host round-trips), control flow on traced values goes through lax
+  combinators, dtypes are pinned, shapes are static, benchmarks fence what
+  they time, host syncs route through the resilience executor, thread
+  targets take the lock, env knobs are declared.  Runs even when jax is
+  broken.
+- **Tier 2 (semantic, registry.py + semantic.py)**: traces every
+  registered jit entry point on the CPU backend with ``jax.make_jaxpr``
+  and checks what only the trace can show — recompile-per-shape across the
+  declared shape matrix, 64-bit promotion under x64, host callbacks per
+  compiled step, and collective axes/volume against the declared mesh
+  contract.
+
+Both tiers report through ``analysis/baseline.json`` (kept empty: fix true
+positives, don't freeze them) and fail CI via ``tools/lint.sh`` /
+``tests/test_graftlint.py`` / ``tests/test_semantic_lint.py``.
 """
 
 from page_rank_and_tfidf_using_apache_spark_tpu.analysis.engine import (
     apply_ratchet,
     baseline_path,
+    changed_python_files,
     default_targets,
     load_baseline,
     repo_root,
@@ -28,6 +38,7 @@ __all__ = [
     "RULES",
     "apply_ratchet",
     "baseline_path",
+    "changed_python_files",
     "default_targets",
     "load_baseline",
     "repo_root",

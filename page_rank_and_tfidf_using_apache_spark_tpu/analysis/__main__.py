@@ -3,12 +3,24 @@
 Usage::
 
     python -m page_rank_and_tfidf_using_apache_spark_tpu.analysis \
-        [paths...] [--json] [--baseline FILE | --no-baseline] \
-        [--write-baseline] [--list-rules]
+        [paths...] [--tier 1|2|all] [--changed-only [BASE]] [--json] \
+        [--baseline FILE | --no-baseline] [--write-baseline] \
+        [--list-rules] [--list-entry-points]
 
-With no paths, scans the tier-1 surface: the package, ``tools/`` and
-``bench.py``.  Exit codes: 0 = no findings beyond the ratchet baseline,
-1 = new findings (printed), 2 = bad invocation.
+Tier 1 is the lexical AST rule set (stdlib-only; runs even when jax is
+broken).  Tier 2 traces the registered jit entry points on the CPU backend
+and checks jaxpr-level invariants (recompile/promotion/transfer/sharding);
+it needs an importable jax.  Both tiers report through the same ratchet
+baseline.
+
+With no paths, tier 1 scans the tier-1 surface (the package, ``tools/``
+and ``bench.py``) and tier 2 traces every registered entry point.  With
+explicit paths (or ``--changed-only``), tier 1 scans those files and tier
+2 runs only the entries whose contracted module is among them — unless an
+``analysis/`` file itself changed, which re-verifies every contract.
+
+Exit codes: 0 = no findings beyond the ratchet baseline, 1 = new findings
+(printed), 2 = bad invocation.
 """
 
 from __future__ import annotations
@@ -25,10 +37,27 @@ from page_rank_and_tfidf_using_apache_spark_tpu.analysis.findings import (
 from page_rank_and_tfidf_using_apache_spark_tpu.analysis.rules import RULES
 
 
+def _relpaths(paths, root: Path) -> set[str]:
+    out: set[str] = set()
+    for f in engine.iter_python_files(paths):
+        try:
+            out.add(f.resolve().relative_to(root.resolve()).as_posix())
+        except ValueError:
+            out.add(f.as_posix())
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to scan (default: package + tools + bench.py)")
+    ap.add_argument("--tier", choices=("1", "2", "all"), default="all",
+                    help="1 = lexical rules, 2 = semantic (jaxpr) checks, "
+                         "all = both (default)")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD", default=None,
+                    metavar="BASE",
+                    help="lint only files changed vs BASE (default HEAD): "
+                         "git worktree diff plus untracked files")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
     ap.add_argument("--baseline", type=Path, default=None,
                     help="ratchet file (default: analysis/baseline.json)")
@@ -39,30 +68,122 @@ def main(argv: list[str] | None = None) -> int:
                          "(new entries get an UNREVIEWED placeholder "
                          "justification you must edit)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-entry-points", action="store_true")
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for rule in RULES.values():
-            print(f"{rule.id:20s} {rule.summary}")
+            print(f"{rule.id:22s} [tier 1] {rule.summary}")
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis.semantic import (
+            SEMANTIC_RULES,
+        )
+
+        for rid, summary in SEMANTIC_RULES.items():
+            print(f"{rid:22s} [tier 2] {summary}")
+        return 0
+
+    if args.list_entry_points:
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis.registry import (
+            ENTRY_POINTS,
+        )
+
+        for ep in ENTRY_POINTS:
+            axes = f" axes={list(ep.axes)}" if ep.axes else ""
+            print(
+                f"{ep.name:32s} {ep.module}{axes} "
+                f"max_compiles={ep.max_compiles} "
+                f"transfer_budget={ep.transfer_budget}"
+            )
         return 0
 
     root = engine.repo_root()
-    paths = args.paths or engine.default_targets(root)
-    missing = [p for p in paths if not p.exists()]
-    if missing:
-        print(f"graftlint: no such path: {missing[0]}", file=sys.stderr)
+    tier1 = args.tier in ("1", "all")
+    tier2 = args.tier in ("2", "all")
+
+    if args.changed_only is not None and args.paths:
+        print("graftlint: give either paths or --changed-only, not both",
+              file=sys.stderr)
         return 2
 
-    findings = engine.run_lint(paths, root)
+    restricted = False  # True when scanning a subset of the surface
+    if args.changed_only is not None:
+        try:
+            changed = engine.changed_python_files(root, args.changed_only)
+        except RuntimeError as exc:
+            print(f"graftlint: {exc}", file=sys.stderr)
+            return 2
+        surface = set(engine.iter_python_files(engine.default_targets(root)))
+        paths = [p for p in changed if p in surface]
+        restricted = True
+        if not paths:
+            print("graftlint: no changed files on the lint surface — clean")
+            return 0
+    elif args.paths:
+        missing = [p for p in args.paths if not p.exists()]
+        if missing:
+            print(f"graftlint: no such path: {missing[0]}", file=sys.stderr)
+            return 2
+        paths = list(args.paths)
+        restricted = True
+    else:
+        paths = engine.default_targets(root)
+
+    if args.write_baseline and args.tier != "all":
+        # A single-tier write would carry over nothing for the other tier's
+        # scanned files, silently deleting its justified entries.
+        print("graftlint: --write-baseline requires --tier all (a partial "
+              "write would wipe the other tier's baseline entries)",
+              file=sys.stderr)
+        return 2
+
+    findings = engine.run_lint(paths, root) if tier1 else []
+
+    scanned = _relpaths(paths, root)
+    if tier2:
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis import semantic
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis.registry import (
+            ENTRY_POINTS,
+        )
+
+        only_modules = None
+        if restricted:
+            # when the analyzer itself changed, every contract is suspect
+            analyzer_changed = any(
+                p.startswith(
+                    "page_rank_and_tfidf_using_apache_spark_tpu/analysis/"
+                )
+                for p in scanned
+            )
+            only_modules = None if analyzer_changed else scanned
+        try:
+            sem = semantic.run_semantic(root=root, only_modules=only_modules)
+        except Exception as exc:
+            # Tier 1 must keep working when jax is broken; tier 2 cannot.
+            # Print what tier 1 found, then fail loudly with a distinct
+            # exit code (2: gate unavailable, vs 1: findings) so callers
+            # like bench.py can tell "dirty" from "could not check".
+            if findings:
+                print(render_human(findings), file=sys.stderr)
+            print(
+                f"graftlint: tier 2 unavailable "
+                f"({type(exc).__name__}: {exc}); rerun with --tier 1 to "
+                "lint without jax",
+                file=sys.stderr,
+            )
+            return 2
+        if sem:
+            findings = engine.assign_fingerprints(list(findings) + sem)
+        # tier-2 findings anchor at their contracted modules: include them
+        # in the written-baseline scan set so --write-baseline is coherent
+        scanned |= {
+            ep.module
+            for ep in ENTRY_POINTS
+            if only_modules is None or ({ep.module, *ep.watch} & only_modules)
+        }
+
     bl_path = args.baseline or engine.baseline_path(root)
 
     if args.write_baseline:
-        scanned = set()
-        for f in engine.iter_python_files(paths):
-            try:
-                scanned.add(f.resolve().relative_to(root.resolve()).as_posix())
-            except ValueError:
-                scanned.add(f.as_posix())
         engine.write_baseline(bl_path, findings, scanned_paths=scanned)
         print(
             f"graftlint: froze {len(findings)} finding(s) over "
@@ -73,13 +194,17 @@ def main(argv: list[str] | None = None) -> int:
 
     baseline = {} if args.no_baseline else engine.load_baseline(bl_path)
     result = engine.apply_ratchet(findings, baseline)
+    # Staleness is only decidable on a full scan with both tiers: a
+    # restricted or single-tier run never re-finds entries for files (or
+    # rules) it did not look at.
+    stale = [] if (restricted or args.tier != "all") else result.stale
 
     if args.json:
         print(
             render_json(
                 result.new,
                 known=len(result.known),
-                stale=[e["fingerprint"] for e in result.stale],
+                stale=[e["fingerprint"] for e in stale],
                 ok=result.ok,
             )
         )
@@ -98,7 +223,7 @@ def main(argv: list[str] | None = None) -> int:
                 f"graftlint: clean ({len(result.known)} baselined finding(s) "
                 f"remain to burn down)"
             )
-        for e in result.stale:
+        for e in stale:
             print(
                 f"graftlint: stale baseline entry {e['fingerprint']} "
                 f"({e['rule']} at {e['path']}) — finding no longer exists; "

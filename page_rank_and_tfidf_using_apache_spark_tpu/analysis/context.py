@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import ast
 import re
+from pathlib import Path
 from typing import Iterator
 
 FuncNode = ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
@@ -110,11 +111,21 @@ def param_names(fn: FuncNode) -> list[str]:
 class FileContext:
     """All the per-file facts rules consume."""
 
-    def __init__(self, relpath: str, source: str, tree: ast.Module):
+    def __init__(
+        self,
+        relpath: str,
+        source: str,
+        tree: ast.Module,
+        root: "Path | None" = None,
+    ):
         self.relpath = relpath
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
+        # Repository root of the scanned tree, for the few rules that need
+        # one cross-file fact (e.g. env-knob-drift reads the declared knob
+        # set out of utils/config.py).  None for bare snippet lints.
+        self.root = root
 
         self.parents: dict[ast.AST, ast.AST] = {}
         for parent in ast.walk(tree):

@@ -52,6 +52,27 @@ def iter_python_files(paths: Sequence[Path]) -> Iterable[Path]:
                     yield f
 
 
+def changed_python_files(root: Path, base: str = "HEAD") -> list[Path]:
+    """Python files changed vs ``base`` (worktree diff + untracked), for
+    ``--changed-only``.  Raises ``RuntimeError`` when git cannot answer —
+    the caller should fall back to a full scan, never silently lint
+    nothing."""
+    import subprocess
+
+    names: set[str] = set()
+    for cmd in (
+        ["git", "-C", str(root), "diff", "--name-only", base, "--"],
+        ["git", "-C", str(root), "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git failed ({' '.join(cmd)}): {proc.stderr.strip()}"
+            )
+        names.update(n for n in proc.stdout.splitlines() if n.endswith(".py"))
+    return sorted(root / n for n in names if (root / n).exists())
+
+
 def lint_file(path: Path, root: Path) -> list[Finding]:
     source = path.read_text(encoding="utf-8")
     try:
@@ -72,7 +93,7 @@ def lint_file(path: Path, root: Path) -> list[Finding]:
             )
         ]
 
-    ctx = FileContext(rel, source, tree)
+    ctx = FileContext(rel, source, tree, root=root)
     findings: list[Finding] = []
     for rule in RULES.values():
         for node, message in rule.check(ctx):

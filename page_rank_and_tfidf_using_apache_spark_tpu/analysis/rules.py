@@ -659,6 +659,271 @@ def check_unguarded_sync(ctx: FileContext) -> Iterator[Hit]:
                 )
 
 
+# --------------------------------------------------------------------------
+# 7. unsynced-thread-state
+# --------------------------------------------------------------------------
+
+# Methods that mutate their receiver in place.
+_MUTATOR_METHODS = frozenset(
+    {
+        "append", "extend", "insert", "add", "update", "setdefault",
+        "pop", "popitem", "remove", "discard", "clear",
+    }
+)
+
+
+def _stmt_target_names(tgt: ast.expr) -> Iterator[str]:
+    if isinstance(tgt, ast.Name):
+        yield tgt.id
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for e in tgt.elts:
+            yield from _stmt_target_names(e)
+    elif isinstance(tgt, ast.Starred):
+        yield from _stmt_target_names(tgt.value)
+
+
+def _module_level_names(tree: ast.Module) -> set[str]:
+    out: set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                out.update(_stmt_target_names(t))
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(stmt.target, ast.Name):
+                out.add(stmt.target.id)
+    return out
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """``with <expr>:`` counts as a critical section when the context
+    expression's dotted spelling mentions a lock (``self._lock``,
+    ``_LOCK``, ``lock.acquire()``, ``threading.RLock()`` ...)."""
+    name = dotted_name(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = call_name(expr)
+    return name is not None and "lock" in name.lower()
+
+
+def _under_lock(node: ast.AST, ctx: FileContext) -> bool:
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)) and any(
+            _is_lockish(item.context_expr) for item in cur.items
+        ):
+            return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False  # a caller's lock is not lexically visible
+        cur = ctx.parents.get(cur)
+    return False
+
+
+def _thread_targets(ctx: FileContext) -> set[FuncNode]:
+    """Functions handed to ``threading.Thread(target=...)``, plus same-file
+    functions they call *outside* a lock (the body effectively runs on the
+    spawned thread too)."""
+    defs_by_name: dict[str, list[FuncNode]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    targets: set[FuncNode] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        if cname not in ("threading.Thread", "Thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Lambda):
+                targets.add(v)
+            elif isinstance(v, ast.Name):
+                targets.update(defs_by_name.get(v.id, []))
+            elif isinstance(v, ast.Attribute):  # target=self._run
+                targets.update(defs_by_name.get(v.attr, []))
+
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(targets):
+            for node in _walk_own_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = call_name(node)
+                if cname is None or "." in cname or _under_lock(node, ctx):
+                    continue
+                for callee in defs_by_name.get(cname, []):
+                    if callee not in targets:
+                        targets.add(callee)
+                        changed = True
+    return targets
+
+
+@rule(
+    "unsynced-thread-state",
+    "module-level or instance state mutated inside a threading.Thread "
+    "target without holding a lock — a data race against the spawning "
+    "thread (the watchdog/prefetch bug class)",
+)
+def check_unsynced_thread_state(ctx: FileContext) -> Iterator[Hit]:
+    targets = _thread_targets(ctx)
+    if not targets:
+        return
+    module_names = _module_level_names(ctx.tree)
+
+    for fn in targets:
+        global_names: set[str] = set()
+        for node in _walk_own_body(fn):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+
+        for node in _walk_own_body(fn):
+            shared: str | None = None
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in tgts:
+                    if isinstance(t, ast.Name) and t.id in global_names:
+                        shared = f"module global `{t.id}`"
+                    elif isinstance(t, (ast.Attribute, ast.Subscript)):
+                        base = dotted_name(t.value)
+                        root_name = (base or "").split(".")[0]
+                        if root_name == "self":
+                            shared = f"instance state `{base}...`"
+                        elif root_name in module_names or root_name in global_names:
+                            shared = f"module-level `{base}`"
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATOR_METHODS
+            ):
+                base = dotted_name(node.func.value)
+                root_name = (base or "").split(".")[0]
+                if root_name == "self":
+                    shared = f"instance state `{base}.{node.func.attr}(...)`"
+                elif root_name in module_names:
+                    shared = f"module-level `{base}.{node.func.attr}(...)`"
+            if shared is None or _under_lock(node, ctx):
+                continue
+            yield (
+                node,
+                f"thread-target function mutates {shared} without holding "
+                "a lock — the spawning thread (or another worker) can race "
+                "this write; guard it with `with <lock>:` or confine the "
+                "state to one thread",
+            )
+
+
+# --------------------------------------------------------------------------
+# 8. env-knob-drift
+# --------------------------------------------------------------------------
+
+_knob_cache: dict[str, frozenset | None] = {}
+
+
+def _parse_declared_knobs(cfg_path) -> frozenset | None:
+    """Lexically extract the GRAFT_ENV_KNOBS literal from a config module
+    (never imports it — the linter must run even when the package is
+    broken).  None when the file has no declaration."""
+    try:
+        tree = ast.parse(cfg_path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if any(isinstance(t, ast.Name) and t.id == "GRAFT_ENV_KNOBS" for t in targets):
+            return frozenset(
+                n.value
+                for n in ast.walk(value)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            )
+    return None
+
+
+def _declared_knobs(ctx: FileContext) -> frozenset | None:
+    from pathlib import Path
+
+    key = str(ctx.root) if ctx.root is not None else ""
+    if key in _knob_cache:
+        return _knob_cache[key]
+    candidates = []
+    if ctx.root is not None:
+        candidates += [
+            ctx.root / "page_rank_and_tfidf_using_apache_spark_tpu/utils/config.py",
+            ctx.root / "utils/config.py",
+        ]
+    # fall back to this package's own declaration (snippet lints)
+    candidates.append(Path(__file__).resolve().parents[1] / "utils" / "config.py")
+    knobs = None
+    for c in candidates:
+        if c.exists():
+            knobs = _parse_declared_knobs(c)
+            if knobs is not None:
+                break
+    _knob_cache[key] = knobs
+    return knobs
+
+
+@rule(
+    "env-knob-drift",
+    "os.environ read of a GRAFT_* knob that is not declared in "
+    "utils/config.py GRAFT_ENV_KNOBS — knobs must be registered (and "
+    "documented) before code may read them",
+)
+def check_env_knob_drift(ctx: FileContext) -> Iterator[Hit]:
+    if ctx.relpath.endswith("utils/config.py"):
+        return  # the declaration site itself
+
+    reads: list[tuple[ast.AST, str]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            cname = call_name(node)
+            if cname in ("os.environ.get", "os.getenv", "environ.get") and node.args:
+                a = node.args[0]
+                if (
+                    isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)
+                    and a.value.startswith("GRAFT_")
+                ):
+                    reads.append((node, a.value))
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            base = dotted_name(node.value)
+            if base in ("os.environ", "environ"):
+                s = node.slice
+                if (
+                    isinstance(s, ast.Constant)
+                    and isinstance(s.value, str)
+                    and s.value.startswith("GRAFT_")
+                ):
+                    reads.append((node, s.value))
+    if not reads:
+        return
+    knobs = _declared_knobs(ctx)
+    for node, knob in reads:
+        if knobs is not None and knob in knobs:
+            continue
+        where = (
+            "no GRAFT_ENV_KNOBS declaration found"
+            if knobs is None
+            else "not in utils/config.py GRAFT_ENV_KNOBS"
+        )
+        yield (
+            node,
+            f"undeclared env knob {knob!r} ({where}) — declare it in "
+            "GRAFT_ENV_KNOBS with a comment and document it in the README "
+            "env-knob table before reading it",
+        )
+
+
 def _use_is_single_element(use: ast.Name, ctx: FileContext) -> bool:
     """True if this load feeds only a constant element access like
     ``x[0]``, ``x[0, 0]`` or ``x.ravel()[0]``."""

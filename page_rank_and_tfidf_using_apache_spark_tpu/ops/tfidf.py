@@ -76,15 +76,20 @@ def count_pairs(
         return SparseCounts(doc=zi, term=zi, count=zf, n_pairs=jnp.array(0, jnp.int32), valid=zf)
     # Lexicographic (valid-first, term-major, doc-minor) sort — avoids a
     # composite int key, which would overflow int32 at vocab 2^18 × many docs.
+    # Multi-operand lax.sort instead of jnp.lexsort: the sorted doc/term/
+    # validity arrays come out directly (no int64 permutation vector, no
+    # post-sort gathers), so every aval in the trace stays at the declared
+    # 32-bit widths — the tier-2 implicit-promotion gate traces this under
+    # x64 and fails on any 64-bit leak.
     if token_valid is not None:
-        order = jnp.lexsort((doc_ids, term_ids, ~token_valid))
+        _, term_s, doc_s, tok_valid_s = jax.lax.sort(
+            (~token_valid, term_ids, doc_ids, token_valid),
+            num_keys=3,
+            is_stable=True,
+        )
     else:
-        order = jnp.lexsort((doc_ids, term_ids))
-    doc_s = doc_ids[order]
-    term_s = term_ids[order]
-    tok_valid_s = (
-        token_valid[order] if token_valid is not None else jnp.ones(cap, dtype=bool)
-    )
+        term_s, doc_s = jax.lax.sort((term_ids, doc_ids), num_keys=2, is_stable=True)
+        tok_valid_s = jnp.ones(cap, dtype=bool)
 
     changed = jnp.logical_or(term_s[1:] != term_s[:-1], doc_s[1:] != doc_s[:-1])
     run_start = jnp.concatenate([jnp.ones(1, bool), changed])
@@ -99,7 +104,7 @@ def count_pairs(
     count_o = jax.ops.segment_sum(
         tok_valid_s.astype(dtype), safe_run, num_segments=cap
     )
-    valid = (jnp.arange(cap) < n_pairs).astype(dtype)
+    valid = (jnp.arange(cap, dtype=jnp.int32) < n_pairs).astype(dtype)
     return SparseCounts(
         doc=doc_o, term=term_o, count=count_o * valid, n_pairs=n_pairs, valid=valid
     )

@@ -16,6 +16,26 @@ import json
 from typing import Any
 
 
+# Every GRAFT_* environment knob the package reads, declared in one place.
+# graftlint's ``env-knob-drift`` rule fails on any ``os.environ`` /
+# ``os.getenv`` read of a ``GRAFT_*`` name that is not listed here, so a new
+# knob cannot ship undocumented (add it here AND to the README env-knob
+# table).  The set is parsed lexically by the linter — keep it a literal.
+GRAFT_ENV_KNOBS: frozenset = frozenset(
+    {
+        "GRAFT_CHAOS",  # fault-injection plan (resilience/chaos.py)
+        "GRAFT_RETRY_MAX",  # max retries per guarded call
+        "GRAFT_SYNC_DEADLINE_S",  # watchdog deadline for host syncs
+        "GRAFT_STEP_DEADLINE_S",  # watchdog deadline for segment dispatch
+        "GRAFT_BACKOFF_BASE_S",  # first backoff delay
+        "GRAFT_BACKOFF_MAX_S",  # backoff ceiling
+        "GRAFT_CKPT_KEEP",  # checkpoint retention count
+        "GRAFT_SEMANTIC_BUDGET_S",  # tools/ci.sh wall-clock budget for the
+        # semantic lint tier (read in bash, declared here all the same)
+    }
+)
+
+
 def ensure_dtype_support(dtype: str) -> None:
     """Enable jax's x64 mode when a 64-bit compute dtype is requested.
 

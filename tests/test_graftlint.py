@@ -430,6 +430,158 @@ def test_unguarded_sync_suppressed(tmp_path):
     )
 
 
+# --------------------------------------------------------------- rule 7
+
+
+THREAD_STATE_TP = """
+import threading
+
+_STATS = {}
+
+def worker():
+    _STATS["done"] = _STATS.get("done", 0) + 1  # unlocked shared write
+
+def spawn():
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    return t
+"""
+
+THREAD_STATE_TP_SELF = """
+import threading
+
+class Pump:
+    def __init__(self):
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def _loop(self):
+        self.count += 1  # instance state, lock exists but is not taken
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+"""
+
+THREAD_STATE_TN = """
+import threading
+
+_STATS = {}
+_LOCK = threading.Lock()
+
+def worker():
+    with _LOCK:
+        _STATS["done"] = _STATS.get("done", 0) + 1  # guarded
+
+def spawn():
+    box = {}
+
+    def runner():
+        box["result"] = 42  # closure state joined before reads: not shared
+
+    t = threading.Thread(target=runner, daemon=True)
+    t.start()
+    t.join()
+    return box
+"""
+
+THREAD_STATE_SUPPRESSED = """
+import threading
+
+_STATS = {}
+
+def worker():
+    _STATS["done"] = 1  # graftlint: disable=unsynced-thread-state (joined before read)
+
+def spawn():
+    threading.Thread(target=worker).start()
+"""
+
+
+def test_thread_state_true_positive(tmp_path):
+    assert "unsynced-thread-state" in rules_hit(
+        lint_snippet(tmp_path, THREAD_STATE_TP)
+    )
+
+
+def test_thread_state_instance_attr_true_positive(tmp_path):
+    assert "unsynced-thread-state" in rules_hit(
+        lint_snippet(tmp_path, THREAD_STATE_TP_SELF)
+    )
+
+
+def test_thread_state_true_negative(tmp_path):
+    assert "unsynced-thread-state" not in rules_hit(
+        lint_snippet(tmp_path, THREAD_STATE_TN)
+    )
+
+
+def test_thread_state_suppressed(tmp_path):
+    assert "unsynced-thread-state" not in rules_hit(
+        lint_snippet(tmp_path, THREAD_STATE_SUPPRESSED)
+    )
+
+
+# --------------------------------------------------------------- rule 8
+
+
+ENV_KNOB_TP = """
+import os
+
+def turbo():
+    return os.environ.get("GRAFT_TURBO_MODE", "0") == "1"  # undeclared knob
+"""
+
+ENV_KNOB_TN = """
+import os
+
+def retries():
+    # declared in utils/config.py GRAFT_ENV_KNOBS
+    keep = os.environ["GRAFT_CKPT_KEEP"]
+    return int(os.environ.get("GRAFT_RETRY_MAX", 3)), keep
+
+def unrelated():
+    return os.environ.get("BENCH_NODES", "0")  # non-GRAFT namespace: free
+"""
+
+ENV_KNOB_SUPPRESSED = """
+import os
+
+def turbo():
+    return os.environ.get("GRAFT_TURBO_MODE")  # graftlint: disable=env-knob-drift (migration shim)
+"""
+
+
+def test_env_knob_true_positive(tmp_path):
+    assert "env-knob-drift" in rules_hit(lint_snippet(tmp_path, ENV_KNOB_TP))
+
+
+def test_env_knob_true_negative(tmp_path):
+    assert "env-knob-drift" not in rules_hit(lint_snippet(tmp_path, ENV_KNOB_TN))
+
+
+def test_env_knob_suppressed(tmp_path):
+    assert "env-knob-drift" not in rules_hit(
+        lint_snippet(tmp_path, ENV_KNOB_SUPPRESSED)
+    )
+
+
+def test_env_knob_reads_local_declaration(tmp_path):
+    """A scanned tree's own utils/config.py declaration wins over the
+    package fallback."""
+    cfg_dir = tmp_path / "utils"
+    cfg_dir.mkdir()
+    (cfg_dir / "config.py").write_text(
+        'GRAFT_ENV_KNOBS = frozenset({"GRAFT_CUSTOM_KNOB"})\n'
+    )
+    ok = 'import os\nV = os.environ.get("GRAFT_CUSTOM_KNOB")\n'
+    bad = 'import os\nV = os.environ.get("GRAFT_RETRY_MAX")\n'  # not declared HERE
+    (tmp_path / "a.py").write_text(ok)
+    (tmp_path / "b.py").write_text(bad)
+    findings = run_lint([tmp_path / "a.py", tmp_path / "b.py"], tmp_path)
+    knob_hits = {f.path for f in findings if f.rule == "env-knob-drift"}
+    assert knob_hits == {"b.py"}
+
+
 # ----------------------------------------------------- engine machinery
 
 
@@ -465,6 +617,8 @@ def test_every_rule_has_summary():
         "nonstatic-shape",
         "dce-timed-region",
         "unguarded-host-sync",
+        "unsynced-thread-state",
+        "env-knob-drift",
     }
     for rule in RULES.values():
         assert rule.summary

@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Full CI pipeline: tier-1 tests, both graftlint tiers, and the chaos gate.
+#
+# The semantic lint tier (tier 2: CPU-only jaxpr tracing of every
+# registered jit entry point) carries a wall-clock budget —
+# GRAFT_SEMANTIC_BUDGET_S, default 60s — so trace-time regressions (an
+# entry point ballooning, a registry builder doing real work) fail CI
+# instead of silently eating the loop.
+#
+# PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced throughout so
+# CI can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tier-1 tests =="
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+
+echo "== graftlint tier 1 (lexical) =="
+tools/lint.sh --tier 1
+
+echo "== graftlint tier 2 (semantic, budget ${GRAFT_SEMANTIC_BUDGET_S:-60}s) =="
+t0=$(date +%s)
+tools/lint.sh --tier 2
+dt=$(( $(date +%s) - t0 ))
+echo "semantic tier: ${dt}s"
+if [ "$dt" -gt "${GRAFT_SEMANTIC_BUDGET_S:-60}" ]; then
+    echo "FAIL: semantic tier exceeded its ${GRAFT_SEMANTIC_BUDGET_S:-60}s budget (${dt}s)" >&2
+    exit 1
+fi
+
+echo "== chaos gate =="
+tools/chaos.sh
+
+echo "CI: all gates green"

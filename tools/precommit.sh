@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Fast pre-commit gate: lint ONLY the files changed vs a base ref.
+#
+#   tools/precommit.sh [BASE]     # default BASE = HEAD (worktree diff)
+#
+# Tier 1 scans just the changed files; tier 2 re-traces only the jit entry
+# points whose contracted module changed (all of them when analysis/ itself
+# changed).  tools/lint.sh remains the full-repo CI gate — this script is
+# the editor-loop companion, typically <2s when nothing jit-adjacent moved.
+#
+# PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
+# can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+BASE="${1:-HEAD}"
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m page_rank_and_tfidf_using_apache_spark_tpu.analysis \
+        --changed-only "$BASE"
