@@ -143,20 +143,28 @@ def probe() -> dict:
 
 def measure_impl(impl: str) -> dict:
     """Run one SpMV impl on the default backend; {'ips':, 'checksum':}."""
+    from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+    with obs.run(f"impl_{impl}"):
+        return _measure_impl_traced(impl, obs)
+
+
+def _measure_impl_traced(impl: str, obs) -> dict:
     import jax
     import jax.numpy as jnp
 
     from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
     from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
 
-    graph = _build_graph()
-    n = graph.n_nodes
-    dg = ops.put_graph(graph, "float32")
-    cfg = PageRankConfig(iterations=ITERS, dangling="redistribute",
-                         init="uniform", dtype="float32", spmv_impl=impl)
-    e_dev = jax.device_put(ops.restart_vector(n, cfg))
-    ranks0 = jax.device_put(ops.init_ranks(n, cfg))
-    runner = ops.make_pagerank_runner(n, cfg)
+    with obs.span("bench.graph"):
+        graph = _build_graph()
+        n = graph.n_nodes
+        dg = ops.put_graph(graph, "float32")
+        cfg = PageRankConfig(iterations=ITERS, dangling="redistribute",
+                             init="uniform", dtype="float32", spmv_impl=impl)
+        e_dev = jax.device_put(ops.restart_vector(n, cfg))
+        ranks0 = jax.device_put(ops.init_ranks(n, cfg))
+        runner = ops.make_pagerank_runner(n, cfg)
 
     # NOTE: on the axon tunnel block_until_ready() does NOT sync; the only
     # reliable fence is fetching a scalar to host.  Subtract the measured
@@ -167,14 +175,17 @@ def measure_impl(impl: str) -> dict:
         checksum = float(jnp.sum(ranks))
         return time.perf_counter() - t0, checksum, float(delta)
 
-    secs, checksum, delta = run_once()
+    with obs.span("bench.compile"):
+        secs, checksum, delta = run_once()
     log(f"[{impl}] first call (compile+{ITERS} iters): {secs:.2f}s")
-    rtt_probe = jax.jit(lambda x: x.sum())
-    float(rtt_probe(e_dev))
-    t0 = time.perf_counter()
-    float(rtt_probe(e_dev))
-    rtt = time.perf_counter() - t0
-    warm = min(run_once()[0] for _ in range(3))
+    with obs.span("bench.rtt"):
+        rtt_probe = jax.jit(lambda x: x.sum())
+        float(rtt_probe(e_dev))
+        t0 = time.perf_counter()
+        float(rtt_probe(e_dev))
+        rtt = time.perf_counter() - t0
+    with obs.span("bench.warm"):
+        warm = min(run_once()[0] for _ in range(3))
     device_secs = max(warm - rtt, 1e-9)
     ips = ITERS / device_secs
     log(f"[{impl}] warm: {warm:.3f}s wall ({rtt * 1e3:.0f}ms rtt) for "
@@ -191,7 +202,20 @@ def measure_tfidf() -> dict:
     checkpoint per chunk, and BENCH_TFIDF_RESUME=1 switches to resume-only
     mode: continue the interrupted ingest from the first unprocessed chunk
     (the BENCH_r05 fix — a 420s timeout used to discard all completed
-    chunks) and report the partial-but-real cumulative throughput."""
+    chunks) and report the partial-but-real cumulative throughput.
+
+    The whole measurement runs as a traced obs run (the parent passes
+    GRAFT_TRACE_DIR): every section is a ``bench.*`` phase span flushed to
+    the JSONL trace, so even a child the parent kills at the timeout
+    leaves a full per-phase, per-chunk accounting behind — the parent
+    reads the artifact instead of scraping this process's stderr."""
+    from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+    with obs.run("tfidf"):
+        return _measure_tfidf_traced(obs)
+
+
+def _measure_tfidf_traced(obs) -> dict:
     from page_rank_and_tfidf_using_apache_spark_tpu.io.text import tokenize_corpus
     from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
         run_tfidf,
@@ -199,7 +223,8 @@ def measure_tfidf() -> dict:
     )
     from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
 
-    docs = _corpus()
+    with obs.span("bench.corpus"):
+        docs = _corpus()
     cfg = TfidfConfig(vocab_bits=18)
     ck_dir = os.environ.get("BENCH_TFIDF_CKPT_DIR")
     # Stride 8: frequent checkpoints would perturb the timed passes (each
@@ -217,7 +242,8 @@ def measure_tfidf() -> dict:
     if ck_dir and os.environ.get("BENCH_TFIDF_RESUME") == "1":
         scfg = TfidfConfig(vocab_bits=18, chunk_tokens=1 << 18, prefetch=2, **ck)
         t0 = time.perf_counter()
-        sout = run_tfidf_streaming(chunks, scfg, resume=True)
+        with obs.span("bench.stream_resume"):
+            sout = run_tfidf_streaming(chunks, scfg, resume=True)
         secs = max(time.perf_counter() - t0, 1e-9)
         toks = int(sum(r["tokens"] for r in sout.metrics.records
                        if r.get("event") == "chunk"))
@@ -245,17 +271,20 @@ def measure_tfidf() -> dict:
                 "resumed": True, "chunks": len(chunks),
                 "n_tokens": toks, "nnz": sout.nnz}
 
-    n_tokens = tokenize_corpus(docs[:64], vocab_bits=18).n_tokens  # warm cheap
-    del n_tokens
+    with obs.span("bench.warmup"):
+        n_tokens = tokenize_corpus(docs[:64], vocab_bits=18).n_tokens  # warm cheap
+        del n_tokens
 
     # batch: run once to compile, once warm
     t0 = time.perf_counter()
-    out = run_tfidf(docs, cfg)
+    with obs.span("bench.batch_cold"):
+        out = run_tfidf(docs, cfg)
     cold = time.perf_counter() - t0
     tok_total = int(sum(r["tokens"] for r in out.metrics.records
                         if r.get("event") == "tokenize"))
     t0 = time.perf_counter()
-    out = run_tfidf(docs, cfg)
+    with obs.span("bench.batch_warm"):
+        out = run_tfidf(docs, cfg)
     warm = time.perf_counter() - t0
     batch_tps = tok_total / warm
     log(f"[tfidf-batch] {len(docs)} docs, {tok_total} tokens: cold {cold:.2f}s "
@@ -269,13 +298,16 @@ def measure_tfidf() -> dict:
     # provided checkpoint dir every pass snapshots per chunk, so a timeout
     # kill leaves a resumable (and accountable) partial run behind.
     scfg0 = TfidfConfig(vocab_bits=18, chunk_tokens=1 << 18, prefetch=0, **ck)
-    sout = run_tfidf_streaming(iter(chunks), scfg0)  # compile + first pass
+    with obs.span("bench.stream_warmup"):
+        sout = run_tfidf_streaming(iter(chunks), scfg0)  # compile + first pass
     t0 = time.perf_counter()
-    sout = run_tfidf_streaming(iter(chunks), scfg0)
+    with obs.span("bench.stream_serial"):
+        sout = run_tfidf_streaming(iter(chunks), scfg0)
     s_serial = time.perf_counter() - t0
     scfg2 = TfidfConfig(vocab_bits=18, chunk_tokens=1 << 18, prefetch=2, **ck)
     t0 = time.perf_counter()
-    sout = run_tfidf_streaming(iter(chunks), scfg2)
+    with obs.span("bench.stream_pipelined"):
+        sout = run_tfidf_streaming(iter(chunks), scfg2)
     s_pipe = time.perf_counter() - t0
     stream_tps = tok_total / min(s_serial, s_pipe)
     log(f"[tfidf-stream] {len(chunks)} chunks: serial {s_serial:.2f}s, "
@@ -291,6 +323,39 @@ def measure_tfidf() -> dict:
 # --------------------------------------------------------------------------
 # parent orchestration (NO jax imports in this section)
 # --------------------------------------------------------------------------
+
+def _trace_report_module():
+    """Load tools/trace_report.py (stdlib-only, NO package/jax imports —
+    safe in the parent) for turning child trace artifacts into the BENCH
+    record's per-phase breakdown."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "trace_report.py")
+    spec = importlib.util.spec_from_file_location("bench_trace_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tfidf_trace_accounting(trace_dir: str) -> dict | None:
+    """Per-phase accounting of the (latest) tfidf child from its trace
+    artifact — works for healthy, resumed and timeout-killed children
+    alike, because the JSONL sink flushes per event.  Reads the artifact,
+    never the child's stderr."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(trace_dir, "tfidf.*.trace.jsonl")),
+                   key=os.path.getmtime)
+    if not paths:
+        return None
+    try:
+        rep = _trace_report_module().report(paths[-1])
+    except Exception as exc:  # a broken trace must not kill the bench
+        log(f"[trace] unreadable tfidf trace: {type(exc).__name__}: {exc}")
+        return None
+    return None if rep.get("empty") else rep
+
 
 def _read_ckpt_meta(ck_dir: str) -> dict | None:
     """Read the latest chunk-checkpoint's metadata without importing the
@@ -434,6 +499,22 @@ def _main(graph_cache: str) -> int:
         child_env.pop("PALLAS_AXON_POOL_IPS", None)
         child_env["JAX_PLATFORMS"] = "cpu"
 
+    # Every measurement child writes its obs run telemetry here (crash-safe
+    # JSONL trace + manifest).  The directory intentionally OUTLIVES the
+    # bench: it is the post-mortem artifact the BENCH record points at
+    # (``extra.trace_path``), so a timed-out child leaves a full accounting
+    # instead of a scraped stderr tail.  Under BENCH_TRACE_DIR each bench
+    # run gets its own pid-scoped subdirectory, so a persistent artifact
+    # root can never attribute a PREVIOUS round's trace to this record.
+    base = os.environ.get("BENCH_TRACE_DIR")
+    if base:
+        trace_dir = os.path.join(base, f"run_{os.getpid()}")
+        os.makedirs(trace_dir, exist_ok=True)
+    else:
+        trace_dir = tempfile.mkdtemp(prefix="bench_trace_")
+    child_env["GRAFT_TRACE_DIR"] = trace_dir
+    log(f"trace artifacts: {trace_dir}")
+
     # --- CPU anchor: scipy CSR power iteration (same math, float32) ---
     import scipy.sparse as sp
 
@@ -553,6 +634,26 @@ def _main(graph_cache: str) -> int:
             "chunks_completed": int(tfidf_out.get("chunks", 0)),
             "resumed": bool(tfidf_out.get("resumed", False)),
         }
+
+    # Per-phase accounting from the tfidf child's trace ARTIFACT (present
+    # for healthy, resumed and timeout-killed children alike) — the BENCH
+    # record's time-breakdown no longer depends on scraping child stderr.
+    extra["trace_path"] = trace_dir
+    if not os.environ.get("BENCH_SKIP_TFIDF"):
+        rep = _tfidf_trace_accounting(trace_dir)
+        if rep:
+            extra["breakdown"] = {
+                k: round(v, 3) for k, v in rep["breakdown"].items()
+            }
+            extra["breakdown_wall_secs"] = round(rep["wall_secs"], 3)
+            if rep["retries"]:
+                extra["trace_retries"] = rep["retries"]
+            if not rep["complete"]:
+                tfidf_record.setdefault("partial", True)
+                if rep.get("last_incomplete"):
+                    tfidf_record["last_incomplete_span"] = (
+                        rep["last_incomplete"]["name"]
+                    )
     if tfidf_record:
         extra["tfidf"] = tfidf_record
 

@@ -660,7 +660,79 @@ def check_unguarded_sync(ctx: FileContext) -> Iterator[Hit]:
 
 
 # --------------------------------------------------------------------------
-# 7. unsynced-thread-state
+# 7. untraced-guarded-site
+# --------------------------------------------------------------------------
+
+# Guarded-executor entry points whose call sites must sit inside an active
+# span: the resilience ladder's retry/watchdog/degrade events are only
+# attributable when the trace records WHICH phase the guarded call served
+# (the round-5 lesson: a 420 s TF-IDF death at chunk 24 left no accounting).
+# Matched as a bare name or under the conventional executor aliases; an
+# explicit jax./np. prefix is the RAW call — unguarded-host-sync territory.
+_GUARDED_LEAVES = frozenset({"device_get", "block_until_ready"})
+_GUARDED_ROOTS = frozenset({"", "rx", "executor", "resilience.executor"})
+# with-items that open a span: obs.span(...) / span(...) and the
+# profiling.annotate(...) alias (which delegates to obs.span).
+_SPAN_LEAVES = frozenset({"span", "annotate"})
+
+
+def _inside_span(node: ast.AST, ctx: FileContext) -> bool:
+    """Is ``node`` lexically inside a ``with obs.span(...)``-style block in
+    its own function?  A caller's span is not lexically visible (same
+    convention as ``_under_lock``): functions whose bodies run guarded
+    calls open their own span."""
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    cname = call_name(expr)
+                    if cname and cname.rsplit(".", 1)[-1] in _SPAN_LEAVES:
+                        return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+        cur = ctx.parents.get(cur)
+    return False
+
+
+@rule(
+    "untraced-guarded-site",
+    "run_guarded / guarded device_get / block_until_ready call site in "
+    "models/, parallel/ or io/ outside an active obs.span — the resilience "
+    "ladder's retry/watchdog/degrade events would land in the trace with "
+    "no phase to attribute them to",
+)
+def check_untraced_guarded_site(ctx: FileContext) -> Iterator[Hit]:
+    parts = ctx.relpath.split("/")
+    if not (set(parts[:-1]) & _GUARDED_TREE_DIRS):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        cname = call_name(node)
+        if cname is None:
+            continue
+        leaf = cname.rsplit(".", 1)[-1]
+        root = cname[: -len(leaf) - 1] if "." in cname else ""
+        guarded = leaf == "run_guarded" or (
+            leaf in _GUARDED_LEAVES and root in _GUARDED_ROOTS
+        )
+        if not guarded:
+            continue
+        if _inside_span(node, ctx):
+            continue
+        yield (
+            node,
+            f"guarded call {cname} outside an active span — wrap the "
+            "region in `with obs.span(\"<phase>\", ...)` so the trace can "
+            "attribute the executor's retry/watchdog/degrade events (and "
+            "the wall time) to a phase",
+        )
+
+
+# --------------------------------------------------------------------------
+# 8. unsynced-thread-state
 # --------------------------------------------------------------------------
 
 # Methods that mutate their receiver in place.
@@ -816,7 +888,7 @@ def check_unsynced_thread_state(ctx: FileContext) -> Iterator[Hit]:
 
 
 # --------------------------------------------------------------------------
-# 8. env-knob-drift
+# 9. env-knob-drift
 # --------------------------------------------------------------------------
 
 _knob_cache: dict[str, frozenset | None] = {}

@@ -14,6 +14,7 @@ import argparse
 import json
 import sys
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
     load_snap,
     save_ranks,
@@ -53,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--resume", action="store_true")
     p.add_argument("--metrics-json", help="dump structured metrics JSON here")
     p.add_argument("--profile-dir", help="jax.profiler trace output dir")
+    p.add_argument("--trace-dir", default=None,
+                   help="obs run-telemetry dir: write <name>.<pid>.trace.jsonl"
+                        " + manifest here (default: $GRAFT_TRACE_DIR)")
     p.add_argument("--mesh", type=int, default=0,
                    help="shard over this many devices (0 = single device)")
     p.add_argument("--shard-strategy",
@@ -69,6 +73,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    # The traced run covers the whole driver: manifest at startup, every
+    # span/retry/checkpoint event flushed per-event to the JSONL trace,
+    # run-end summary at exit (no-op without --trace-dir/GRAFT_TRACE_DIR).
+    with obs.run("pagerank", trace_dir=args.trace_dir):
+        return _main(args)
+
+
+def _main(args) -> int:
     metrics = MetricsRecorder()
 
     with Timer() as t_load:

@@ -14,6 +14,7 @@ import argparse
 import json
 import sys
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
     iter_corpus_chunks,
     iter_corpus_dir,
@@ -67,6 +68,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--top-k", type=int, default=10)
     p.add_argument("--metrics-json")
     p.add_argument("--profile-dir")
+    p.add_argument("--trace-dir", default=None,
+                   help="obs run-telemetry dir: write <name>.<pid>.trace.jsonl"
+                        " + manifest here (default: $GRAFT_TRACE_DIR)")
     return p
 
 
@@ -74,6 +78,14 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.mesh and not args.streaming:
         raise SystemExit("--mesh requires --streaming (chunked ingest)")
+    # The traced run covers the whole driver: manifest at startup, every
+    # span/retry/checkpoint event flushed per-event to the JSONL trace,
+    # run-end summary at exit (no-op without --trace-dir/GRAFT_TRACE_DIR).
+    with obs.run("tfidf", trace_dir=args.trace_dir):
+        return _main(args)
+
+
+def _main(args) -> int:
     metrics = MetricsRecorder()
 
     if args.streaming:

@@ -122,27 +122,34 @@ def tokenize_corpus(
     the host tokenizer as the Wikipedia-scale bottleneck), falling back to
     the numpy FNV sweep.  ``doc_id_offset`` lets streaming ingest assign
     globally unique doc ids chunk by chunk.
+
+    Each call is an ``io.tokenize`` span: the tokenizer is the documented
+    Wikipedia-scale bottleneck, so its exact share of a traced run (vs
+    padding/dispatch/drain) must be separable in the timeline — including
+    when it runs on the streaming prefetch thread.
     """
+    from page_rank_and_tfidf_using_apache_spark_tpu import obs
     from page_rank_and_tfidf_using_apache_spark_tpu.utils import native
 
-    res = native.tokenize_and_hash(
-        docs,
-        vocab_bits=vocab_bits,
-        ngram=ngram,
-        lowercase=lowercase,
-        min_token_len=min_token_len,
-    )
-    if res is not None:
-        doc_ids, term_ids, doc_lengths = res
-    else:
-        per_doc: list[list[str]] = [
-            add_ngrams(tokenize(d, lowercase=lowercase, min_token_len=min_token_len), ngram)
-            for d in docs
-        ]
-        doc_lengths = np.fromiter((len(p) for p in per_doc), dtype=np.int32, count=len(per_doc))
-        flat = [t for p in per_doc for t in p]
-        term_ids = hash_to_vocab(fnv1a_64(flat), vocab_bits)
-        doc_ids = np.repeat(np.arange(len(docs), dtype=np.int32), doc_lengths)
+    with obs.span("io.tokenize", docs=len(docs)):
+        res = native.tokenize_and_hash(
+            docs,
+            vocab_bits=vocab_bits,
+            ngram=ngram,
+            lowercase=lowercase,
+            min_token_len=min_token_len,
+        )
+        if res is not None:
+            doc_ids, term_ids, doc_lengths = res
+        else:
+            per_doc: list[list[str]] = [
+                add_ngrams(tokenize(d, lowercase=lowercase, min_token_len=min_token_len), ngram)
+                for d in docs
+            ]
+            doc_lengths = np.fromiter((len(p) for p in per_doc), dtype=np.int32, count=len(per_doc))
+            flat = [t for p in per_doc for t in p]
+            term_ids = hash_to_vocab(fnv1a_64(flat), vocab_bits)
+            doc_ids = np.repeat(np.arange(len(docs), dtype=np.int32), doc_lengths)
 
     names = tuple(doc_names) if doc_names is not None else tuple(
         f"doc{doc_id_offset + i}" for i in range(len(docs))

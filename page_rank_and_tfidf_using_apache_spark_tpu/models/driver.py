@@ -15,6 +15,7 @@ from typing import Callable
 
 import numpy as np
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
@@ -131,7 +132,7 @@ def run_segments(
                 if todo not in cpu_invokes:
                     cpu_invokes[todo] = make_cpu_invoke(seg_cfg)
                 return cpu_invokes[todo](rd)
-        with Timer() as t:
+        with Timer() as t, obs.span("pagerank.segment", start=done, todo=todo):
             ranks_dev, iters, delta = rx.run_guarded(
                 lambda r=runners[todo], rd=ranks_dev: invoke(r, rd),
                 site="pagerank_step", policy=policy, metrics=metrics,
@@ -139,6 +140,7 @@ def run_segments(
             )
         done += int(iters)
         last_delta = float(delta)
+        obs.histogram("pagerank.segment_secs", t.elapsed)
         metrics.record(
             iter=done,
             l1_delta=last_delta,
@@ -147,10 +149,11 @@ def run_segments(
             **(extra_metrics or {}),
         )
         if cfg.checkpoint_every > 0 and cfg.checkpoint_dir and done < cfg.iterations:
-            path = ckpt.save_checkpoint(
-                cfg.checkpoint_dir, done,
-                {"ranks": extract_np(ranks_dev)}, cfg.config_hash(),
-            )
+            with obs.span("pagerank.checkpoint", iter=done):
+                path = ckpt.save_checkpoint(
+                    cfg.checkpoint_dir, done,
+                    {"ranks": extract_np(ranks_dev)}, cfg.config_hash(),
+                )
             metrics.record(event="checkpoint", path=path, iter=done)
         if cfg.tol > 0.0:
             # the while_loop runner handled tolerance in-program; one

@@ -21,6 +21,7 @@ import dataclasses
 import jax
 import numpy as np
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
 from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
@@ -76,36 +77,42 @@ def run_pagerank(
         runner = make(n, seg_cfg)
 
         def cpu_invoke(rd):
-            cpu = jax.devices("cpu")[0]
-            with jax.default_device(cpu):
-                dg_cpu = ops.put_graph(graph, cfg.dtype)
-                e_cpu = jax.device_put(
-                    rx.device_get(e, site="pagerank_cpu_pull"), cpu
-                )
-                rd_cpu = jax.device_put(
-                    rx.device_get(rd, site="pagerank_cpu_pull"), cpu
-                )
-                out, iters, delta = runner(dg_cpu, rd_cpu, e_cpu)
-                delta = float(delta)
+            with obs.span("pagerank.cpu_degrade"):
+                cpu = jax.devices("cpu")[0]
+                with jax.default_device(cpu):
+                    dg_cpu = ops.put_graph(graph, cfg.dtype)
+                    e_cpu = jax.device_put(
+                        rx.device_get(e, site="pagerank_cpu_pull"), cpu
+                    )
+                    rd_cpu = jax.device_put(
+                        rx.device_get(rd, site="pagerank_cpu_pull"), cpu
+                    )
+                    out, iters, delta = runner(dg_cpu, rd_cpu, e_cpu)
+                    delta = float(delta)
             return out, iters, delta
 
         return cpu_invoke
+
+    def extract_np(rd):
+        with obs.span("pagerank.ckpt_pull"):
+            return rx.device_get(
+                rd, site="pagerank_ckpt_pull", metrics=metrics,
+                checkpoint_dir=cfg.checkpoint_dir,
+            )
 
     ranks_dev, done, last_delta = driver.run_segments(
         cfg, metrics, ranks_dev, start_iter,
         make_runner=lambda seg_cfg: make(n, seg_cfg),
         invoke=invoke,
-        extract_np=lambda rd: rx.device_get(
-            rd, site="pagerank_ckpt_pull", metrics=metrics,
-            checkpoint_dir=cfg.checkpoint_dir,
-        ),
+        extract_np=extract_np,
         segments_allowed=not cfg.spark_exact,
         make_cpu_invoke=make_cpu_invoke,
     )
-    ranks_np = rx.device_get(
-        ranks_dev, site="pagerank_result_pull", metrics=metrics,
-        checkpoint_dir=cfg.checkpoint_dir,
-    )
+    with obs.span("pagerank.result_pull"):
+        ranks_np = rx.device_get(
+            ranks_dev, site="pagerank_result_pull", metrics=metrics,
+            checkpoint_dir=cfg.checkpoint_dir,
+        )
     return PageRankResult(
         ranks=ranks_np, iterations=done, l1_delta=last_delta, metrics=metrics
     )

@@ -27,11 +27,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
-from page_rank_and_tfidf_using_apache_spark_tpu.utils import profiling
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, TfMode, ensure_dtype_support
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 
@@ -71,6 +71,7 @@ def run_tfidf(
     """Batch TF-IDF: tokenize on host, one compiled device pipeline."""
     ensure_dtype_support(cfg.dtype)
     metrics = metrics or MetricsRecorder()
+    # tokenize_corpus opens its own "io.tokenize" span — no wrapper here
     with Timer() as t_tok:
         corpus = tio.tokenize_corpus(
             docs,
@@ -82,7 +83,7 @@ def run_tfidf(
         )
     metrics.record(event="tokenize", docs=corpus.n_docs, tokens=corpus.n_tokens, secs=t_tok.elapsed)
 
-    with Timer() as t_dev:
+    with Timer() as t_dev, obs.span("tfidf.pipeline"):
         result = ops.tfidf_pipeline(
             jnp.asarray(corpus.doc_ids),
             jnp.asarray(corpus.term_ids),
@@ -238,35 +239,36 @@ def finalize_tfidf(
     count_a = np.concatenate([p[2] for p in st.parts]).astype(dtype)
     doc_lengths = np.concatenate(st.doc_length_parts)
 
-    idf = rx.device_get(
-        ops.idf_vector(jnp.asarray(df_total), float(max(n_docs, 1)), cfg.idf_mode),
-        site="tfidf_finalize_sync", metrics=metrics,
-        checkpoint_dir=cfg.checkpoint_dir,
-    )
-    with Timer() as t_fin:
-        if doc_a.shape[0] >= DEVICE_FINALIZE_MIN_NNZ:
-            weight = rx.device_get(ops.finalize_weights(
-                jnp.asarray(doc_a), jnp.asarray(count_a),
-                jnp.asarray(doc_lengths), jnp.asarray(idf[term_a]),
-                n_docs=max(n_docs, 1), tf_mode=cfg.tf_mode,
-                l2_normalize=cfg.l2_normalize,
-            ), site="tfidf_finalize_sync", metrics=metrics,
-               checkpoint_dir=cfg.checkpoint_dir)
-            where = "device"
-        else:
-            if cfg.tf_mode is TfMode.RAW:
-                tf = count_a
-            elif cfg.tf_mode is TfMode.FREQ:
-                tf = count_a / np.maximum(doc_lengths[doc_a].astype(dtype), 1.0)
-            else:  # LOGNORM
-                tf = np.where(count_a > 0, 1.0 + np.log(np.maximum(count_a, 1.0)),
-                              0.0).astype(dtype)
-            weight = tf * idf[term_a]
-            if cfg.l2_normalize:
-                sq = np.zeros(n_docs, dtype)
-                np.add.at(sq, doc_a, weight * weight)
-                weight = weight / np.sqrt(np.maximum(sq, 1e-30))[doc_a]
-            where = "host"
+    with obs.span("tfidf.finalize", nnz=int(doc_a.shape[0])):
+        idf = rx.device_get(
+            ops.idf_vector(jnp.asarray(df_total), float(max(n_docs, 1)), cfg.idf_mode),
+            site="tfidf_finalize_sync", metrics=metrics,
+            checkpoint_dir=cfg.checkpoint_dir,
+        )
+        with Timer() as t_fin:
+            if doc_a.shape[0] >= DEVICE_FINALIZE_MIN_NNZ:
+                weight = rx.device_get(ops.finalize_weights(
+                    jnp.asarray(doc_a), jnp.asarray(count_a),
+                    jnp.asarray(doc_lengths), jnp.asarray(idf[term_a]),
+                    n_docs=max(n_docs, 1), tf_mode=cfg.tf_mode,
+                    l2_normalize=cfg.l2_normalize,
+                ), site="tfidf_finalize_sync", metrics=metrics,
+                   checkpoint_dir=cfg.checkpoint_dir)
+                where = "device"
+            else:
+                if cfg.tf_mode is TfMode.RAW:
+                    tf = count_a
+                elif cfg.tf_mode is TfMode.FREQ:
+                    tf = count_a / np.maximum(doc_lengths[doc_a].astype(dtype), 1.0)
+                else:  # LOGNORM
+                    tf = np.where(count_a > 0, 1.0 + np.log(np.maximum(count_a, 1.0)),
+                                  0.0).astype(dtype)
+                weight = tf * idf[term_a]
+                if cfg.l2_normalize:
+                    sq = np.zeros(n_docs, dtype)
+                    np.add.at(sq, doc_a, weight * weight)
+                    weight = weight / np.sqrt(np.maximum(sq, 1e-30))[doc_a]
+                where = "host"
     metrics.record(event="finalize", where=where, nnz=int(doc_a.shape[0]),
                    secs=t_fin.elapsed)
     metrics.scalar("n_docs", n_docs)
@@ -323,15 +325,16 @@ def _tokenized_chunks(
                     "original chunking (e.g. the same --chunk-docs)"
                 )
             continue  # already ingested before the resume point
-        with profiling.annotate("tfidf_tokenize"):
-            corpus = tio.tokenize_corpus(
-                docs,
-                vocab_bits=cfg.vocab_bits,
-                ngram=cfg.ngram,
-                lowercase=cfg.lowercase,
-                min_token_len=cfg.min_token_len,
-                doc_id_offset=n_docs,
-            )
+        # tokenize_corpus opens its own "io.tokenize" span (also on the
+        # prefetch thread) — no wrapper here
+        corpus = tio.tokenize_corpus(
+            docs,
+            vocab_bits=cfg.vocab_bits,
+            ngram=cfg.ngram,
+            lowercase=cfg.lowercase,
+            min_token_len=cfg.min_token_len,
+            doc_id_offset=n_docs,
+        )
         n_docs += corpus.n_docs
         yield i, corpus
 
@@ -429,7 +432,7 @@ def run_tfidf_streaming(
 
     def drain_one():
         i, counts, df_inc, doc_lengths, n_chunk_docs, n_tokens, t = inflight.popleft()
-        with Timer() as t_sync, profiling.annotate("tfidf_chunk_sync"):
+        with Timer() as t_sync, obs.span("tfidf.chunk", chunk=i):
             # Wait for this chunk's device results with ONE batched
             # device->host pull.  The old path paid five round-trips per
             # chunk (int(n_pairs) fence + three sliced np.asarray pulls +
@@ -458,24 +461,27 @@ def run_tfidf_streaming(
         metrics.record(event="chunk", chunk=i, docs=st.n_docs, tokens=n_tokens,
                        pairs=k, dispatch_secs=round(t.elapsed, 6),
                        secs=t_sync.elapsed)
+        obs.counter("tfidf.chunks")
+        obs.histogram("tfidf.chunk_secs", t_sync.elapsed)
         if (cfg.checkpoint_every > 0 and cfg.checkpoint_dir
                 and st.chunk_index % cfg.checkpoint_every == 0):
             st.ingest_secs = secs0 + (time.perf_counter() - run_started)
             save_ingest_checkpoint(cfg, metrics, st)
 
-    for i, corpus in source:
-        cap, _ = grow_chunk_cap(corpus.n_tokens, cap, metrics, chunk=i)
-        doc_ids, term_ids, valid = _pad_chunk(corpus, cap)
-        with Timer() as t:
-            counts, df_inc = ops.chunk_counts(
-                jnp.asarray(doc_ids), jnp.asarray(term_ids), jnp.asarray(valid),
-                vocab=vocab,
-            )  # async dispatch — no block here
-        inflight.append((i, counts, df_inc, corpus.doc_lengths,
-                         corpus.n_docs, corpus.n_tokens, t))
-        while len(inflight) > depth:
+    with obs.span("tfidf.stream", resume_chunk=st.chunk_index):
+        for i, corpus in source:
+            cap, _ = grow_chunk_cap(corpus.n_tokens, cap, metrics, chunk=i)
+            doc_ids, term_ids, valid = _pad_chunk(corpus, cap)
+            with Timer() as t:
+                counts, df_inc = ops.chunk_counts(
+                    jnp.asarray(doc_ids), jnp.asarray(term_ids), jnp.asarray(valid),
+                    vocab=vocab,
+                )  # async dispatch — no block here
+            inflight.append((i, counts, df_inc, corpus.doc_lengths,
+                             corpus.n_docs, corpus.n_tokens, t))
+            while len(inflight) > depth:
+                drain_one()
+        while inflight:
             drain_one()
-    while inflight:
-        drain_one()
 
     return finalize_tfidf(st, cfg, metrics)

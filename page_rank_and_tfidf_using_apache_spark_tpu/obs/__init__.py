@@ -1,0 +1,75 @@
+"""obs/ — unified run telemetry (ISSUE 4).
+
+The Spark-UI/event-log counterpart this reproduction was missing: every
+long path publishes structured events onto one process-global bus
+(:mod:`obs.events`), host phases open context-propagated spans bridged to
+``jax.profiler.TraceAnnotation`` (:mod:`obs.trace`), and a traced run
+writes a crash-safe per-event-flushed JSONL trace plus a startup/exit
+manifest (:mod:`obs.runtime`, :mod:`obs.manifest`).  ``tools/trace_report.py``
+(stdlib-only, importable from the jax-free bench parent) reconstructs
+per-phase wall-time breakdowns, retry/chaos tallies per site, the chunk
+timeline, and the last incomplete span from a trace file — a SIGKILLed
+child yields a full accounting instead of a stderr tail.
+
+Spark-UI correspondence (also in README "Observability"):
+
+==========================  =============================================
+Spark                       here
+==========================  =============================================
+event log                   ``<name>.<pid>.trace.jsonl`` (JSONL sink)
+application page / conf     ``<name>.<pid>.manifest.json``
+stage/task timeline         spans (``obs.span("tfidf.chunk", chunk=24)``)
+stage counters              ``obs.counter/gauge/histogram`` + run summary
+task failure / retry log    ``retry``/``backoff``/``watchdog``/``chaos``
+                            /``degraded``/``exhausted`` events
+==========================  =============================================
+
+Env knobs: ``GRAFT_TRACE_DIR`` (default trace directory — a run started
+with no explicit dir writes here; unset = in-memory only) and
+``GRAFT_LOG_LEVEL`` (stderr log level, utils/metrics.py).  Both declared
+in ``utils/config.GRAFT_ENV_KNOBS``.
+"""
+
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.events import (
+    Aggregates,
+    EventBus,
+    JsonlSink,
+    MemorySink,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.manifest import knob_snapshot
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.runtime import (
+    Run,
+    bus,
+    counter,
+    current_run,
+    emit,
+    end_run,
+    gauge,
+    histogram,
+    run,
+    span,
+    start_run,
+    tracer,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.trace import SpanTracer
+
+__all__ = [
+    "Aggregates",
+    "EventBus",
+    "JsonlSink",
+    "MemorySink",
+    "Run",
+    "SpanTracer",
+    "bus",
+    "counter",
+    "current_run",
+    "emit",
+    "end_run",
+    "gauge",
+    "histogram",
+    "knob_snapshot",
+    "run",
+    "span",
+    "start_run",
+    "tracer",
+]

@@ -65,6 +65,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.compat import shard_map
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
 from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
 from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import PageRankResult
@@ -534,20 +535,25 @@ def run_pagerank_sharded(
     # (collectives over its axis), so there is no single-device re-lowering
     # to degrade to.  Exhausted retries raise ResilienceExhausted carrying
     # the checkpoint; rerunning with --mesh 0 --resume IS the degraded path.
+    def extract_np(rd):
+        with obs.span("pagerank.ckpt_pull"):
+            return rx.device_get(
+                rd, site="pagerank_ckpt_pull", metrics=metrics,
+                checkpoint_dir=cfg.checkpoint_dir,
+            )[sg.node_map]
+
     ranks_dev, done, last_delta = driver.run_segments(
         cfg, metrics, ranks_dev, start_iter,
         make_runner=lambda seg_cfg: make_sharded_runner(sg, seg_cfg, mesh),
         invoke=invoke,
-        extract_np=lambda rd: rx.device_get(
-            rd, site="pagerank_ckpt_pull", metrics=metrics,
-            checkpoint_dir=cfg.checkpoint_dir,
-        )[sg.node_map],
+        extract_np=extract_np,
         extra_metrics={"devices": d},
     )
-    ranks_np = rx.device_get(
-        ranks_dev, site="pagerank_result_pull", metrics=metrics,
-        checkpoint_dir=cfg.checkpoint_dir,
-    )
+    with obs.span("pagerank.result_pull"):
+        ranks_np = rx.device_get(
+            ranks_dev, site="pagerank_result_pull", metrics=metrics,
+            checkpoint_dir=cfg.checkpoint_dir,
+        )
     return PageRankResult(
         ranks=ranks_np[sg.node_map], iterations=done,
         l1_delta=last_delta, metrics=metrics,

@@ -25,6 +25,7 @@ import numpy as np
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
     IngestState,
@@ -142,7 +143,8 @@ def run_tfidf_sharded(
             valid[i, : c.n_tokens] = True
             st.doc_length_parts.append(c.doc_lengths)
 
-        with Timer() as t:
+        with Timer() as t, obs.span("tfidf.super_chunk", step=step,
+                                    chunk=st.chunk_index):
             (c_doc, c_term, c_cnt, c_np, _c_valid), df = kernel(
                 jax.device_put(doc_ids, esh),
                 jax.device_put(term_ids, esh),

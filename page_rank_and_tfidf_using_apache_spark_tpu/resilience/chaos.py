@@ -49,6 +49,8 @@ import re
 import threading
 import time
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
 
 class ChaosError(RuntimeError):
     """Injected *transient* device error (stands in for the retryable
@@ -124,6 +126,10 @@ class ChaosPlan:
         for inj in self.injections:
             if not inj.matches(site, count):
                 continue
+            # published BEFORE the fault takes effect: the injection must be
+            # on record even when it hangs or kills the run it fires in
+            obs.emit("chaos", site=site, fault=inj.kind, call=count)
+            obs.counter("chaos_injections")
             if inj.kind == "hang":
                 time.sleep(inj.param)
                 return

@@ -31,6 +31,14 @@ Retry safety: every guarded callable here is re-invocable — ``device_get``
 re-reads live device buffers, and the compiled segment runners are
 functional (same inputs in, same ranks out), so a retried dispatch cannot
 double-apply work.
+
+Telemetry (ISSUE 4): every rung publishes a structured event on the obs
+bus — ``retry`` / ``backoff`` per retried attempt, ``watchdog`` when the
+sync deadline fires, ``degraded`` on the CPU rung, ``exhausted`` before
+raising — so a traced run's JSONL file records *which* site failed, how
+many retries it ate and what each backoff cost, durably, even when the
+process is later killed.  ``metrics.record`` mirrors the retry/degraded
+events into the legacy per-run recorder for callers that pass one.
 """
 
 from __future__ import annotations
@@ -42,6 +50,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
@@ -153,6 +162,8 @@ def _attempt(fn: Callable[[], Any], site: str, policy: RetryPolicy) -> Any:
     t.start()
     t.join(policy.deadline_s)
     if t.is_alive():
+        obs.emit("watchdog", site=site, deadline_s=policy.deadline_s)
+        obs.counter("watchdog_fires")
         raise SyncDeadlineExceeded(
             f"guarded call at {site!r} exceeded the {policy.deadline_s}s "
             "sync deadline (hung host sync); abandoning the attempt thread"
@@ -199,20 +210,32 @@ def run_guarded(
             if attempts > policy.max_retries:
                 break
             delay = backoff_delay(site, attempts, policy)
+            err = f"{type(exc).__name__}: {exc}"[:200]
+            obs.emit("retry", site=site, attempt=attempts, error=err,
+                     backoff_s=round(delay, 4))
+            obs.counter("retries")
             if metrics is not None:
                 metrics.record(
                     event="retry", site=site, attempt=attempts,
-                    error=f"{type(exc).__name__}: {exc}"[:200],
-                    backoff_s=round(delay, 4),
+                    error=err, backoff_s=round(delay, 4),
                 )
             time.sleep(delay)
+            # emitted AFTER the sleep: it records that the backoff completed
+            # (a kill mid-backoff then shows a retry with no backoff event),
+            # which is what distinguishes it from the retry event above
+            obs.emit("backoff", site=site, attempt=attempts,
+                     secs=round(delay, 4))
+            obs.histogram("backoff_secs", delay)
 
     if fallback is not None:
+        err = f"{type(last_exc).__name__}: {last_exc}"[:200]
+        obs.emit("degraded", site=site, ladder="cpu", after_attempts=attempts,
+                 error=err)
+        obs.counter("degraded")
         if metrics is not None:
             metrics.record(
                 event="degraded", site=site, ladder="cpu",
-                after_attempts=attempts,
-                error=f"{type(last_exc).__name__}: {last_exc}"[:200],
+                after_attempts=attempts, error=err,
             )
         try:
             return fallback()
@@ -221,6 +244,12 @@ def run_guarded(
 
     assert last_exc is not None
     last_ckpt = ckpt.latest_checkpoint(checkpoint_dir) if checkpoint_dir else None
+    obs.emit(
+        "exhausted", site=site, attempts=attempts,
+        error=f"{type(last_exc).__name__}: {last_exc}"[:200],
+        checkpoint=last_ckpt,
+    )
+    obs.counter("exhausted")
     raise ResilienceExhausted(site, attempts, last_exc, last_ckpt) from last_exc
 
 

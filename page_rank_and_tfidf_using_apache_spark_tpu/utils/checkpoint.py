@@ -21,6 +21,8 @@ from typing import Any
 
 import numpy as np
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
 
 _META_KEY = "__ckpt_meta__"
 _CKPT_RE = re.compile(r"^ckpt_(\d{8})\.npz$")
@@ -67,6 +69,12 @@ def save_checkpoint(
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    try:
+        nbytes = os.path.getsize(path)
+    except OSError:
+        nbytes = None  # a concurrent gc may beat us to it — tolerated above
+    obs.emit("checkpoint_save", path=path, step=int(step), bytes=nbytes)
+    obs.counter("checkpoint_saves")
     if keep is None:
         keep = int(os.environ.get("GRAFT_CKPT_KEEP", 8))
     if keep > 0:
@@ -105,6 +113,9 @@ def gc_checkpoints(directory: str, keep: int) -> list[str]:
             deleted.append(path)
         except FileNotFoundError:
             pass  # concurrent gc — already gone
+    if deleted:
+        obs.emit("checkpoint_gc", directory=directory, deleted=len(deleted),
+                 keep=keep)
     return deleted
 
 
@@ -140,4 +151,5 @@ def load_checkpoint(
             f"but current config is {expect_config_hash}; refusing to resume "
             "across semantic changes"
         )
+    obs.emit("checkpoint_resume", path=path, step=int(meta["step"]))
     return meta["step"], arrays, meta["extra"]

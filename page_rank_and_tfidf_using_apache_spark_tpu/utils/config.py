@@ -32,6 +32,9 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         "GRAFT_CKPT_KEEP",  # checkpoint retention count
         "GRAFT_SEMANTIC_BUDGET_S",  # tools/ci.sh wall-clock budget for the
         # semantic lint tier (read in bash, declared here all the same)
+        "GRAFT_LOG_LEVEL",  # stderr log level (utils/metrics.py; default INFO)
+        "GRAFT_TRACE_DIR",  # obs/ run-telemetry output dir: traced runs write
+        # <name>.<pid>.trace.jsonl + .manifest.json here (unset = no trace)
     }
 )
 

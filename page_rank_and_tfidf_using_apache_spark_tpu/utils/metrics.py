@@ -4,6 +4,17 @@ Reference counterpart: the Spark UI stage/task counters and log4j lines.
 Here every iteration emits one structured record
 (``iter, l1_delta, dangling_mass, secs``), collected in-memory and dumpable
 as JSON for the bench harness that feeds BASELINE.md.
+
+Since ISSUE 4 the recorder is a *publisher* onto the obs event bus: every
+``record(...)`` also lands on the process bus as a ``kind="metric"`` event,
+so a traced run's JSONL file carries the full legacy record stream next to
+the span/retry/checkpoint telemetry — and the recorder itself is
+thread-safe (records arrive from the streaming tokenizer/prefetch threads
+as well as the main loop; the ``unsynced-thread-state`` lint patrols
+exactly this class of mutation).
+
+The stderr log level follows the ``GRAFT_LOG_LEVEL`` env knob (default
+INFO; declared in ``utils/config.GRAFT_ENV_KNOBS``).
 """
 
 from __future__ import annotations
@@ -11,36 +22,66 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import sys
+import threading
 import time
 from typing import Any, Iterator
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+
+def resolve_log_level(spec: str | None, default: int = logging.INFO) -> int:
+    """Map a GRAFT_LOG_LEVEL string ('debug', 'WARNING', '30', ...) to a
+    logging level int; unknown spellings fall back to ``default``."""
+    if not spec:
+        return default
+    spec = spec.strip()
+    if spec.isdigit():
+        # "0" means "log everything": setLevel(NOTSET) would instead defer
+        # to the root logger (WARNING), silencing the metric lines
+        return int(spec) or logging.DEBUG
+    level = logging.getLevelName(spec.upper())
+    return level if isinstance(level, int) else default
+
 
 logger = logging.getLogger("pr_tfidf_tpu")
 if not logger.handlers:
     _h = logging.StreamHandler(sys.stderr)
     _h.setFormatter(logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s"))
     logger.addHandler(_h)
-    logger.setLevel(logging.INFO)
+    logger.setLevel(resolve_log_level(os.environ.get("GRAFT_LOG_LEVEL")))
 
 
 @dataclasses.dataclass
 class MetricsRecorder:
-    """Collects per-step structured records and run-level scalars."""
+    """Collects per-step structured records and run-level scalars.
+
+    Thread-safe: ``record``/``scalar`` may be called from worker threads
+    (streaming prefetch, watchdog) concurrently with the main loop."""
 
     records: list[dict[str, Any]] = dataclasses.field(default_factory=list)
     scalars: dict[str, Any] = dataclasses.field(default_factory=dict)
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
 
     def record(self, **kwargs: Any) -> None:
-        self.records.append(kwargs)
+        with self._lock:
+            self.records.append(kwargs)
+        obs.emit("metric", **kwargs)
         logger.info("%s", json.dumps(kwargs, default=float))
 
     def scalar(self, name: str, value: Any) -> None:
-        self.scalars[name] = value
+        with self._lock:
+            self.scalars[name] = value
 
     def to_json(self) -> str:
-        return json.dumps(
-            {"records": self.records, "scalars": self.scalars}, default=float
-        )
+        with self._lock:
+            return json.dumps(
+                {"records": list(self.records), "scalars": dict(self.scalars)},
+                default=float,
+            )
 
     def dump(self, path: str) -> None:
         with open(path, "w") as f:

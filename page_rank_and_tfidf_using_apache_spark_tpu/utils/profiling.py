@@ -1,16 +1,23 @@
 """Tracing / profiling (SURVEY.md §5.1).
 
-Reference counterpart: the Spark web UI + event log.  Here the equivalent is
-an XLA device trace: ``trace(logdir)`` wraps a region in
-``jax.profiler.trace`` producing a TensorBoard-compatible profile of every
-compiled program and collective, and ``annotate(name)`` marks host-side
-phases so ingest vs compute shows up in the timeline.
+Reference counterpart: the Spark web UI + event log.  Two layers here:
+
+- ``trace(logdir)`` wraps a region in ``jax.profiler.trace`` producing a
+  TensorBoard-compatible profile of every compiled program and collective;
+- ``annotate(name)`` marks a host-side phase.  Since ISSUE 4 this is an
+  alias for :func:`obs.span`: the phase lands in the run's crash-safe
+  JSONL trace (with nesting, thread identity and wall time) *and* — when
+  jax is imported — in the XLA profiler timeline via
+  ``jax.profiler.TraceAnnotation``, so host phases line up with device
+  timelines in one view.
 """
 
 from __future__ import annotations
 
 import contextlib
 from typing import Iterator
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
 
 
 @contextlib.contextmanager
@@ -25,10 +32,7 @@ def trace(logdir: str | None) -> Iterator[None]:
         yield
 
 
-@contextlib.contextmanager
-def annotate(name: str) -> Iterator[None]:
-    """Named host-side phase, visible in the profiler timeline."""
-    import jax.profiler
-
-    with jax.profiler.TraceAnnotation(name):
-        yield
+def annotate(name: str, **attrs):
+    """Named host-side phase: an obs span (JSONL trace + nesting) bridged
+    to the jax profiler timeline when jax is loaded."""
+    return obs.span(name, **attrs)

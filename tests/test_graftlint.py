@@ -430,7 +430,102 @@ def test_unguarded_sync_suppressed(tmp_path):
     )
 
 
-# --------------------------------------------------------------- rule 7
+# ------------------------------------------------- rule 7: untraced spans
+
+
+UNTRACED_GUARDED_TP = """
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+
+def drain(counts, cfg, metrics):
+    host = rx.device_get(counts, site="tfidf_chunk_sync", metrics=metrics)
+    out = rx.run_guarded(lambda: 1, site="tfidf_chunk_sync")
+    return host, out
+"""
+
+UNTRACED_GUARDED_TN = """
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import profiling
+
+def drain(counts, cfg, metrics, i):
+    with obs.span("tfidf.chunk", chunk=i):
+        host = rx.device_get(counts, site="tfidf_chunk_sync", metrics=metrics)
+    with profiling.annotate("tfidf_chunk_sync"):  # the obs.span alias
+        out = rx.run_guarded(lambda: 1, site="tfidf_chunk_sync")
+    return host, out
+"""
+
+UNTRACED_GUARDED_SUPPRESSED = """
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+
+def drain(counts):
+    return rx.device_get(counts, site="boot")  # graftlint: disable=untraced-guarded-site (pre-run bootstrap pull)
+"""
+
+
+def test_untraced_guarded_true_positive(tmp_path):
+    findings = [f for f in lint_models_snippet(tmp_path, UNTRACED_GUARDED_TP)
+                if f.rule == "untraced-guarded-site"]
+    assert len(findings) == 2  # the guarded pull AND the run_guarded call
+
+
+def test_untraced_guarded_true_negative(tmp_path):
+    assert "untraced-guarded-site" not in rules_hit(
+        lint_models_snippet(tmp_path, UNTRACED_GUARDED_TN)
+    )
+
+
+def test_untraced_guarded_ignores_other_directories(tmp_path):
+    """resilience/ itself (and tools/, bench.py) legitimately hold bare
+    guarded calls — the rule patrols the execution paths only."""
+    f = tmp_path / "snippet.py"
+    f.write_text(UNTRACED_GUARDED_TP)
+    assert "untraced-guarded-site" not in rules_hit(lint_file(f, tmp_path))
+
+
+def test_untraced_guarded_catches_bare_imports(tmp_path):
+    """`from ...executor import device_get` must not evade the rule: the
+    bare leaf is matched like the rx./executor. aliases (an explicit jax.
+    prefix is the RAW call — unguarded-host-sync's beat, not this rule's)."""
+    code = """
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience.executor import (
+    device_get,
+)
+
+def drain(counts):
+    return device_get(counts, site="s")
+"""
+    findings = [f for f in lint_models_snippet(tmp_path, code)
+                if f.rule == "untraced-guarded-site"]
+    assert len(findings) == 1
+
+
+def test_untraced_guarded_callers_span_not_visible(tmp_path):
+    """A span in the CALLER does not cover a guarded call in a helper —
+    same lexical convention as the lock rule: the helper opens its own."""
+    code = """
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+
+def helper(counts):
+    return rx.device_get(counts, site="s")
+
+def caller(counts):
+    with obs.span("phase"):
+        return helper(counts)
+"""
+    findings = [f for f in lint_models_snippet(tmp_path, code)
+                if f.rule == "untraced-guarded-site"]
+    assert len(findings) == 1
+
+
+def test_untraced_guarded_suppressed(tmp_path):
+    assert "untraced-guarded-site" not in rules_hit(
+        lint_models_snippet(tmp_path, UNTRACED_GUARDED_SUPPRESSED)
+    )
+
+
+# --------------------------------------------------------------- rule 8
 
 
 THREAD_STATE_TP = """
@@ -617,6 +712,7 @@ def test_every_rule_has_summary():
         "nonstatic-shape",
         "dce-timed-region",
         "unguarded-host-sync",
+        "untraced-guarded-site",
         "unsynced-thread-state",
         "env-knob-drift",
     }

@@ -1,0 +1,436 @@
+"""Run-telemetry tests (ISSUE 4): the obs/ span tracer + event bus +
+crash-safe JSONL sinks + run manifests, and the trace-driven accounting
+pipeline (tools/trace_report.py, bench.py ``extra.breakdown``).
+
+Acceptance bars exercised here:
+
+- a chaos-injected (``GRAFT_CHAOS=*:fail@%5``) streaming TF-IDF run
+  SIGKILLed mid-stream leaves a parseable trace from which trace_report
+  recovers per-chunk wall time, retry counts per site, and the last
+  incomplete span;
+- ``python bench.py`` on the CPU backend emits a BENCH record whose
+  ``extra.breakdown`` phases sum to within 10% of the measured wall time,
+  with the accounting read from the trace artifact (not stderr).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    GRAFT_ENV_KNOBS,
+    TfidfConfig,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import (
+    MetricsRecorder,
+    resolve_log_level,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", REPO / "tools" / "trace_report.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def sink():
+    s = obs.MemorySink()
+    obs.bus().attach(s)
+    yield s
+    obs.bus().detach(s)
+
+
+# ---------------------------------------------------------------- tracer
+
+
+def test_span_nesting_and_status(sink):
+    with obs.span("outer", k=1) as outer_id:
+        with obs.span("inner") as inner_id:
+            pass
+    with pytest.raises(ValueError):
+        with obs.span("boom"):
+            raise ValueError("x")
+    ends = {e["name"]: e for e in sink.of_kind("span_end")}
+    assert ends["inner"]["parent"] == outer_id
+    assert ends["outer"]["parent"] is None
+    assert ends["outer"]["attrs"] == {"k": 1}
+    assert inner_id != outer_id
+    assert ends["inner"]["secs"] >= 0
+    assert ends["boom"]["status"] == "error:ValueError"
+    # begin published before the body ran (crash evidence by construction)
+    kinds = [e["kind"] for e in sink.events if e.get("name") == "inner"]
+    assert kinds == ["span_begin", "span_end"]
+
+
+def test_span_nesting_across_threads(sink):
+    """Each thread keeps its own span stack: concurrent nests never steal
+    each other's parent, and a fresh thread starts at top level even while
+    the spawning thread holds an open span."""
+    barrier = threading.Barrier(2)
+
+    def work(tag: str):
+        with obs.span(f"{tag}.root"):
+            barrier.wait()  # both threads inside their roots at once
+            with obs.span(f"{tag}.child"):
+                barrier.wait()
+
+    with obs.span("main.open"):  # must NOT become any thread's parent
+        threads = [
+            threading.Thread(target=work, args=(t,), name=t) for t in ("a", "b")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+    ends = {e["name"]: e for e in sink.of_kind("span_end")}
+    for tag in ("a", "b"):
+        assert ends[f"{tag}.root"]["parent"] is None  # fresh thread = top level
+        assert ends[f"{tag}.child"]["parent"] == ends[f"{tag}.root"]["span"]
+        assert ends[f"{tag}.child"]["thread"] == tag
+
+
+def test_explicit_cross_thread_parent(sink):
+    """Cross-thread parentage is available by passing parent= explicitly
+    (the prefetch pattern: worker spans attributed to the coordinator)."""
+    with obs.span("coordinator") as cid:
+        pass
+
+    def worker():
+        with obs.span("worker", parent=cid):
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    end = [e for e in sink.of_kind("span_end") if e["name"] == "worker"][0]
+    assert end["parent"] == cid
+
+
+# ------------------------------------------------------------- event bus
+
+
+def test_broken_sink_is_detached_not_fatal(sink):
+    class Broken:
+        def emit(self, event):
+            raise RuntimeError("sink died")
+
+    broken = Broken()
+    obs.bus().attach(broken)
+    obs.emit("ping")  # must not raise
+    assert obs.bus().sink_count() >= 1
+    obs.emit("pong")
+    kinds = sink.kinds()
+    assert "ping" in kinds and "pong" in kinds
+
+
+def test_metrics_recorder_thread_safe_and_forwards(sink):
+    m = MetricsRecorder()
+    n_threads, per = 8, 200
+
+    def pump(k):
+        for i in range(per):
+            m.record(event="x", thread=k, i=i)
+
+    threads = [threading.Thread(target=pump, args=(k,)) for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(m.records) == n_threads * per
+    assert len(sink.of_kind("metric")) >= n_threads * per
+
+
+def test_resolve_log_level():
+    import logging
+
+    assert resolve_log_level(None) == logging.INFO
+    assert resolve_log_level("debug") == logging.DEBUG
+    assert resolve_log_level("WARNING") == logging.WARNING
+    assert resolve_log_level("15") == 15
+    assert resolve_log_level("bogus") == logging.INFO
+
+
+def test_graft_log_level_knob_declared():
+    assert "GRAFT_LOG_LEVEL" in GRAFT_ENV_KNOBS
+    assert "GRAFT_TRACE_DIR" in GRAFT_ENV_KNOBS
+
+
+# ----------------------------------------------------- chaos/retry events
+
+
+def test_chaos_injected_retry_publishes_events(sink):
+    pol = rx.RetryPolicy(max_retries=3, backoff_base_s=0.001)
+    with chaos.inject("obs_t1:fail@1;obs_t1:fail@2"):
+        out = rx.run_guarded(lambda: 42, site="obs_t1", policy=pol)
+    assert out == 42
+    chaos_evts = [e for e in sink.of_kind("chaos") if e["site"] == "obs_t1"]
+    retry_evts = [e for e in sink.of_kind("retry") if e["site"] == "obs_t1"]
+    backoffs = [e for e in sink.of_kind("backoff") if e["site"] == "obs_t1"]
+    assert len(chaos_evts) == 2 and chaos_evts[0]["fault"] == "fail"
+    assert len(retry_evts) == 2
+    assert retry_evts[0]["attempt"] == 1 and "ChaosError" in retry_evts[0]["error"]
+    assert len(backoffs) == 2 and all(b["secs"] > 0 for b in backoffs)
+
+
+def test_exhausted_and_degraded_events(sink):
+    pol = rx.RetryPolicy(max_retries=1, backoff_base_s=0.001)
+    with chaos.inject("obs_t2:lost@1+"):
+        out = rx.run_guarded(lambda: 1, site="obs_t2", policy=pol,
+                             fallback=lambda: "cpu")
+    assert out == "cpu"
+    assert [e["site"] for e in sink.of_kind("degraded")] == ["obs_t2"]
+    with chaos.inject("obs_t3:fail@1+"):
+        with pytest.raises(Exception):
+            rx.run_guarded(lambda: 1, site="obs_t3", policy=pol)
+    exh = sink.of_kind("exhausted")
+    assert exh and exh[-1]["site"] == "obs_t3" and exh[-1]["attempts"] == 2
+
+
+def test_watchdog_event_on_deadline(sink):
+    pol = rx.RetryPolicy(max_retries=1, backoff_base_s=0.001, deadline_s=0.1)
+    with chaos.inject("obs_t4:hang@1:5"):
+        out = rx.run_guarded(lambda: "ok", site="obs_t4", policy=pol)
+    assert out == "ok"
+    wd = [e for e in sink.of_kind("watchdog") if e["site"] == "obs_t4"]
+    assert len(wd) == 1 and wd[0]["deadline_s"] == 0.1
+
+
+# ------------------------------------------------------- run + manifest
+
+
+def test_manifest_knob_snapshot(tmp_path, monkeypatch):
+    monkeypatch.setenv("GRAFT_RETRY_MAX", "7")
+    monkeypatch.setenv("GRAFT_CHAOS", "s:fail@1")
+    monkeypatch.delenv("GRAFT_CKPT_KEEP", raising=False)
+    run = obs.start_run("knobtest", trace_dir=str(tmp_path))
+    try:
+        with open(run.manifest_path) as f:
+            man = json.load(f)
+        assert set(man["knobs"]) == set(GRAFT_ENV_KNOBS)
+        assert man["knobs"]["GRAFT_RETRY_MAX"] == "7"
+        assert man["knobs"]["GRAFT_CHAOS"] == "s:fail@1"
+        assert man["knobs"]["GRAFT_CKPT_KEEP"] is None
+        assert man["status"] == "running" and man["pid"] == os.getpid()
+        assert man["backend"] == "cpu"  # jax is imported in the test session
+        assert man["device_count"] == 8  # the simulated test mesh
+        assert "lint_clean" in man
+    finally:
+        obs.end_run()
+    with open(run.manifest_path) as f:
+        man = json.load(f)
+    assert man["status"] == "ok"
+    assert man["wall_secs"] > 0 and man["events"] >= 2
+    assert "summary" in man
+
+
+def test_run_counters_and_summary(tmp_path):
+    with obs.run("aggtest", trace_dir=str(tmp_path)) as r:
+        obs.counter("widgets")
+        obs.counter("widgets", 2)
+        obs.gauge("level", 0.5)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            obs.histogram("lat", v)
+    rep = _trace_report().report(r.trace_path)
+    s = rep["summary"]
+    assert s["counters"]["widgets"] == 3
+    assert s["gauges"]["level"] == 0.5
+    h = s["histograms"]["lat"]
+    assert h["count"] == 4 and h["min"] == 1.0 and h["max"] == 4.0
+    assert abs(h["mean"] - 2.5) < 1e-9
+    assert rep["complete"] and rep["status"] == "ok"
+
+
+def test_run_supersede_and_error_status(tmp_path):
+    r1 = obs.start_run("first", trace_dir=str(tmp_path))
+    r2 = obs.start_run("second", trace_dir=str(tmp_path))  # supersedes r1
+    obs.end_run()
+    with open(r1.manifest_path) as f:
+        assert json.load(f)["status"] == "superseded"
+    with open(r2.manifest_path) as f:
+        assert json.load(f)["status"] == "ok"
+    with pytest.raises(RuntimeError):
+        with obs.run("third", trace_dir=str(tmp_path)) as r3:
+            raise RuntimeError("boom")
+    with open(r3.manifest_path) as f:
+        assert json.load(f)["status"] == "error:RuntimeError"
+
+
+# ------------------------------------------- trace-driven accounting
+
+
+def test_traced_streaming_run_report(tmp_path):
+    """A healthy traced streaming run: breakdown covers the stream +
+    finalize phases, the chunk timeline is complete, nothing dangling."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+        run_tfidf_streaming,
+    )
+
+    docs = [f"tok{i} tok{i % 5} shared word" for i in range(24)]
+    chunks = [docs[i:i + 4] for i in range(0, len(docs), 4)]
+    with obs.run("streamtest", trace_dir=str(tmp_path)) as r:
+        run_tfidf_streaming(chunks, TfidfConfig(vocab_bits=8, prefetch=0))
+    rep = _trace_report().report(r.trace_path)
+    assert rep["complete"] and not rep["last_incomplete"]
+    assert set(rep["breakdown"]) >= {"tfidf.stream", "tfidf.finalize"}
+    assert [c["chunk"] for c in rep["chunks"]] == list(range(6))
+    assert all(c["complete"] and c["secs"] >= 0 for c in rep["chunks"])
+    assert rep["summary"]["counters"]["tfidf.chunks"] == 6
+    # phases nest under the main thread's top level only — no double count
+    assert sum(rep["breakdown"].values()) <= rep["wall_secs"] * 1.02 + 0.02
+
+
+KILL_CHILD = """
+import os, signal, sys
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    run_tfidf_streaming,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+
+
+def chunks():
+    for i in range(40):
+        if i == 12:
+            os.kill(os.getpid(), signal.SIGKILL)  # die mid-stream, no cleanup
+        yield [f"tok{j} tok{j % 5} shared word c{i}" for j in range(4)]
+
+
+obs.start_run("killtest")
+run_tfidf_streaming(chunks(), TfidfConfig(vocab_bits=8, prefetch=0))
+"""
+
+
+def test_sigkilled_chaos_run_leaves_full_accounting(tmp_path):
+    """ISSUE 4 acceptance: a chaos-injected (*:fail@%5) streaming TF-IDF
+    run SIGKILLed mid-stream leaves a parseable JSONL trace from which
+    trace_report recovers (a) per-chunk wall time for every completed
+    chunk, (b) retry counts per site, (c) the last incomplete span — plus
+    a manifest frozen at status "running" with the chaos knob on record."""
+    script = tmp_path / "kill_child.py"
+    script.write_text(textwrap.dedent(KILL_CHILD))
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=str(REPO),  # the script runs from tmp_path
+        GRAFT_TRACE_DIR=str(tmp_path),
+        GRAFT_CHAOS="*:fail@%5",
+        GRAFT_BACKOFF_BASE_S="0.001",
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, str(script)], capture_output=True, text=True,
+        timeout=240, env=env, cwd=REPO,
+    )
+    assert proc.returncode == -signal.SIGKILL, proc.stderr[-2000:]
+
+    traces = sorted(tmp_path.glob("killtest.*.trace.jsonl"))
+    assert len(traces) == 1
+    tr = _trace_report()
+    events, bad = tr.load_events(str(traces[0]))
+    assert events and bad <= 1  # at most the single SIGKILL-truncated line
+
+    rep = tr.report(str(traces[0]))
+    assert rep["complete"] is False and rep["status"] == "killed"
+    # (a) per-chunk wall time for chunks 0..11 (the kill lands fetching #12)
+    done = [c for c in rep["chunks"] if c["complete"]]
+    assert [c["chunk"] for c in done] == list(range(12))
+    assert all(c["secs"] > 0 for c in done)
+    # (b) retry count per site: %5 chaos fired at guarded calls 5 and 10
+    assert rep["chaos"].get("tfidf_chunk_sync", 0) >= 2
+    assert rep["retries"].get("tfidf_chunk_sync", 0) >= 2
+    # (c) the last incomplete span names the phase the process died inside
+    assert rep["last_incomplete"] is not None
+    assert rep["last_incomplete"]["name"] == "tfidf.stream"
+    assert "tfidf.stream" in rep["incomplete_phases"]
+
+    manifests = sorted(tmp_path.glob("killtest.*.manifest.json"))
+    assert len(manifests) == 1
+    man = json.loads(manifests[0].read_text())
+    assert man["status"] == "running"  # SIGKILL: never finalized — evidence
+    assert man["knobs"]["GRAFT_CHAOS"] == "*:fail@%5"
+
+
+def test_trace_report_cli(tmp_path):
+    with obs.run("clitest", trace_dir=str(tmp_path)) as r:
+        with obs.span("phase.a"):
+            pass
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         r.trace_path, "--json"],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rep = json.loads(proc.stdout)
+    assert rep["complete"] and "phase.a" in rep["breakdown"]
+    human = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"), r.trace_path],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert human.returncode == 0 and "phase.a" in human.stdout
+
+
+# ---------------------------------------------------- bench integration
+
+
+def test_bench_breakdown_sums_to_wall():
+    """ISSUE 4 acceptance: bench.py on the CPU backend emits a BENCH
+    record whose extra.breakdown phases sum to within 10% of the measured
+    wall time (the tfidf child's run span), read from the trace artifact —
+    no stderr scraping on the accounting path."""
+    import tempfile
+
+    trace_dir = tempfile.mkdtemp(prefix="obs_bench_trace_")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        BENCH_NODES="400", BENCH_EDGES="1600", BENCH_ITERS="2",
+        BENCH_IMPLS="segment", BENCH_IMPL_TIMEOUT_S="180",
+        BENCH_PROBE_TIMEOUT_S="90",
+        BENCH_TFIDF_DOCS="256", BENCH_TFIDF_TOKENS_PER_DOC="30",
+        BENCH_TFIDF_CHUNK_DOCS="64",
+        BENCH_TFIDF_TIMEOUT_S="300",
+        BENCH_TRACE_DIR=trace_dir,
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "bench.py")],
+        capture_output=True, text=True, timeout=560, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    extra = record["extra"]
+    # pid-scoped subdir: a persistent BENCH_TRACE_DIR never lets a previous
+    # round's trace masquerade as this record's accounting
+    assert Path(extra["trace_path"]).parent == Path(trace_dir)
+    breakdown = extra["breakdown"]
+    wall = extra["breakdown_wall_secs"]
+    assert breakdown and wall > 0
+    assert {"bench.batch_cold", "bench.stream_serial"} <= set(breakdown)
+    total = sum(breakdown.values())
+    assert abs(total - wall) / wall <= 0.10, (breakdown, wall)
+    assert extra["tfidf"]["partial"] is False
+    # the artifacts themselves survive for post-mortems
+    run_dir = Path(extra["trace_path"])
+    assert list(run_dir.glob("tfidf.*.trace.jsonl"))
+    assert list(run_dir.glob("impl_segment.*.manifest.json"))

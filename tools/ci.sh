@@ -30,6 +30,37 @@ if [ "$dt" -gt "${GRAFT_SEMANTIC_BUDGET_S:-60}" ]; then
     exit 1
 fi
 
+echo "== traced-run smoke (obs + trace_report) =="
+# A tiny streaming TF-IDF run under GRAFT_TRACE_DIR must leave a JSONL
+# trace + manifest that tools/trace_report.py turns into a per-phase
+# breakdown with a completed chunk timeline — the artifact path bench.py's
+# accounting depends on.
+smoke_dir=$(mktemp -d)
+trap 'rm -rf "$smoke_dir"' EXIT
+printf 'alpha beta gamma\nbeta gamma delta\nepsilon zeta alpha\ngamma gamma beta\nalpha delta epsilon\nzeta zeta beta\n' \
+    > "$smoke_dir/corpus.txt"
+if ! env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu GRAFT_TRACE_DIR="$smoke_dir" \
+    python -m page_rank_and_tfidf_using_apache_spark_tpu.cli.tfidf \
+        "$smoke_dir/corpus.txt" --lines --streaming --chunk-docs 2 \
+        --vocab-bits 8 --prefetch 0 > "$smoke_dir/cli.log" 2>&1; then
+    echo "FAIL: traced tfidf CLI run; its output:" >&2
+    cat "$smoke_dir/cli.log" >&2
+    exit 1
+fi
+trace_file=$(ls "$smoke_dir"/tfidf.*.trace.jsonl)
+python tools/trace_report.py "$trace_file" --json > "$smoke_dir/report.json"
+python - "$smoke_dir/report.json" <<'EOF'
+import json, sys
+rep = json.load(open(sys.argv[1]))
+assert rep["complete"], f"traced run did not finish: {rep}"
+assert "tfidf.stream" in rep["breakdown"], rep["breakdown"]
+assert len(rep["chunks"]) == 3 and all(c["complete"] for c in rep["chunks"]), rep["chunks"]
+assert rep["manifest"] and rep["manifest"]["status"] == "ok", rep["manifest"]
+print("traced-run smoke: OK "
+      f"({rep['events']} events, {len(rep['chunks'])} chunks, "
+      f"wall {rep['wall_secs']:.3f}s)")
+EOF
+
 echo "== chaos gate =="
 tools/chaos.sh
 

@@ -1,0 +1,281 @@
+#!/usr/bin/env python3
+"""Reconstruct a run's accounting from an obs trace file.
+
+Reads the crash-safe JSONL trace the ``obs`` subsystem writes
+(``<name>.<pid>.trace.jsonl``) and answers "where did the time go" — the
+Spark-web-UI question — even for a run that was SIGKILLed mid-stream:
+
+- per-phase wall-time **breakdown** (top-level spans on the main thread,
+  grouped by name; incomplete spans are credited with their elapsed time
+  up to the last event on record and flagged),
+- the per-chunk **timeline** (``tfidf.chunk`` spans → chunk index, wall
+  seconds, start offset),
+- **retry / chaos / watchdog / degraded / exhausted tallies per site**
+  (the resilience executor's event stream),
+- the **last incomplete span** — the phase the process died inside,
+- the run manifest (sibling ``.manifest.json``) and run-end summary when
+  present.
+
+Deliberately stdlib-only with no package imports: the bench parent (which
+must never import jax) imports this module to turn child trace artifacts
+into the BENCH record's ``extra.breakdown`` — no stderr scraping.
+
+Usage::
+
+    python tools/trace_report.py RUN.trace.jsonl [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any
+
+
+def load_events(path: str) -> tuple[list[dict[str, Any]], int]:
+    """Parse a JSONL trace; returns (events, bad_line_count).  A SIGKILL
+    mid-write truncates at most the final line — skip unparseable lines
+    rather than failing the whole post-mortem."""
+    events: list[dict[str, Any]] = []
+    bad = 0
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                evt = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if isinstance(evt, dict) and "kind" in evt:
+                events.append(evt)
+            else:
+                bad += 1
+    return events, bad
+
+
+def pair_spans(
+    events: list[dict[str, Any]], last_t: float
+) -> tuple[list[dict[str, Any]], list[dict[str, Any]]]:
+    """Match span_begin/span_end into span records.
+
+    Returns (complete, incomplete).  Incomplete spans (begin with no end —
+    the process died inside them) get ``secs`` = elapsed up to the last
+    event on record and ``complete: False``.
+    """
+    open_spans: dict[int, dict[str, Any]] = {}
+    complete: list[dict[str, Any]] = []
+    for evt in events:
+        if evt["kind"] == "span_begin":
+            open_spans[evt["span"]] = {
+                "span": evt["span"],
+                "parent": evt.get("parent"),
+                "name": evt.get("name", "?"),
+                "attrs": evt.get("attrs") or {},
+                "thread": evt.get("thread"),
+                "t0": evt["t"],
+                "complete": True,
+            }
+        elif evt["kind"] == "span_end":
+            rec = open_spans.pop(evt["span"], None)
+            if rec is None:  # end without begin: trace started mid-run
+                rec = {
+                    "span": evt["span"],
+                    "parent": evt.get("parent"),
+                    "name": evt.get("name", "?"),
+                    "attrs": evt.get("attrs") or {},
+                    "thread": evt.get("thread"),
+                    "t0": evt["t"] - evt.get("secs", 0.0),
+                    "complete": True,
+                }
+            rec["secs"] = evt.get("secs", 0.0)
+            rec["status"] = evt.get("status", "ok")
+            complete.append(rec)
+    incomplete = []
+    for rec in open_spans.values():
+        rec["complete"] = False
+        rec["secs"] = max(last_t - rec["t0"], 0.0)
+        rec["status"] = "incomplete"
+        incomplete.append(rec)
+    return complete, incomplete
+
+
+def _tally(events: list[dict[str, Any]], kind: str) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for evt in events:
+        if evt["kind"] == kind:
+            site = str(evt.get("site", evt.get("name", "?")))
+            out[site] = out.get(site, 0) + 1
+    return out
+
+
+def report(path: str) -> dict[str, Any]:
+    """Full accounting for one trace file, as a JSON-ready dict."""
+    events, bad = load_events(path)
+    if not events:
+        return {"trace": path, "events": 0, "bad_lines": bad, "empty": True}
+    t_first = events[0]["t"]
+    t_last = max(e["t"] for e in events)
+    run_start = next((e for e in events if e["kind"] == "run_start"), None)
+    run_end = next((e for e in events if e["kind"] == "run_end"), None)
+    # A sink_detached tombstone means the trace was truncated by a sink
+    # write error, NOT by the process dying — keep the two separable.
+    sink_lost = any(e["kind"] == "sink_detached" for e in events)
+    t0 = run_start["t"] if run_start else t_first
+    wall = (run_end["t"] if run_end else t_last) - t0
+
+    spans, incomplete = pair_spans(events, t_last)
+    all_spans = spans + incomplete
+
+    # Breakdown: top-level (parentless) spans on the thread that owns the
+    # run — concurrent worker-thread spans (the streaming tokenizer)
+    # overlap the main timeline and would double-count wall time.
+    main_thread = (run_start or events[0]).get("thread")
+    breakdown: dict[str, float] = {}
+    incomplete_phases: list[str] = []
+    for rec in all_spans:
+        if rec["parent"] is not None or rec.get("thread") != main_thread:
+            continue
+        breakdown[rec["name"]] = breakdown.get(rec["name"], 0.0) + rec["secs"]
+        if not rec["complete"]:
+            incomplete_phases.append(rec["name"])
+
+    # Per-span-name aggregates (all threads, all depths).
+    span_stats: dict[str, dict[str, float]] = {}
+    for rec in all_spans:
+        s = span_stats.setdefault(rec["name"], {"count": 0, "secs": 0.0})
+        s["count"] += 1
+        s["secs"] += rec["secs"]
+
+    chunks = sorted(
+        (
+            {
+                "chunk": rec["attrs"].get("chunk"),
+                "secs": rec["secs"],
+                "t_rel": rec["t0"] - t0,
+                "complete": rec["complete"],
+            }
+            for rec in all_spans
+            if rec["name"] == "tfidf.chunk" and "chunk" in rec["attrs"]
+        ),
+        key=lambda c: c["t_rel"],
+    )
+
+    last_incomplete = None
+    if incomplete:
+        deepest = max(incomplete, key=lambda r: r["t0"])
+        last_incomplete = {
+            "name": deepest["name"],
+            "span": deepest["span"],
+            "attrs": deepest["attrs"],
+            "elapsed_secs": deepest["secs"],
+            "thread": deepest.get("thread"),
+        }
+
+    manifest = None
+    mpath = path.replace(".trace.jsonl", ".manifest.json")
+    if mpath != path and os.path.exists(mpath):
+        try:
+            with open(mpath, "r", encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            manifest = None
+
+    return {
+        "trace": path,
+        "manifest": manifest,
+        "events": len(events),
+        "bad_lines": bad,
+        "complete": run_end is not None,
+        "status": (
+            run_end.get("status")
+            if run_end
+            else ("trace-lost" if sink_lost else "killed")
+        ),
+        "wall_secs": wall,
+        "breakdown": breakdown,
+        "incomplete_phases": incomplete_phases,
+        "spans": span_stats,
+        "chunks": chunks,
+        "retries": _tally(events, "retry"),
+        "backoffs": _tally(events, "backoff"),
+        "chaos": _tally(events, "chaos"),
+        "watchdog": _tally(events, "watchdog"),
+        "degraded": _tally(events, "degraded"),
+        "exhausted": _tally(events, "exhausted"),
+        "checkpoints": sum(e["kind"] == "checkpoint_save" for e in events),
+        "last_incomplete": last_incomplete,
+        "summary": run_end.get("summary") if run_end else None,
+    }
+
+
+def render_human(rep: dict[str, Any]) -> str:
+    if rep.get("empty"):
+        return f"{rep['trace']}: empty trace ({rep['bad_lines']} bad line(s))"
+    lines = [f"trace: {rep['trace']}"]
+    man = rep.get("manifest")
+    if man:
+        lines.append(
+            f"run: {man.get('name')} pid={man.get('pid')} "
+            f"backend={man.get('backend')} git={man.get('git_sha')} "
+            f"status={man.get('status')}"
+        )
+    lines.append(
+        f"events: {rep['events']} ({rep['bad_lines']} bad), "
+        f"wall {rep['wall_secs']:.3f}s, "
+        + ("run completed" if rep["complete"] else "RUN DID NOT END (killed?)")
+    )
+    if rep["breakdown"]:
+        lines.append("phase breakdown (top-level, main thread):")
+        total = sum(rep["breakdown"].values())
+        for name, secs in sorted(rep["breakdown"].items(), key=lambda kv: -kv[1]):
+            mark = "  [incomplete]" if name in rep["incomplete_phases"] else ""
+            pct = 100.0 * secs / rep["wall_secs"] if rep["wall_secs"] > 0 else 0.0
+            lines.append(f"  {name:32s} {secs:10.3f}s {pct:5.1f}%{mark}")
+        lines.append(f"  {'(phases total)':32s} {total:10.3f}s")
+    if rep["chunks"]:
+        done = [c for c in rep["chunks"] if c["complete"]]
+        lines.append(
+            f"chunks: {len(done)} complete of {len(rep['chunks'])} started"
+        )
+        worst = sorted(done, key=lambda c: -c["secs"])[:5]
+        for c in worst:
+            lines.append(
+                f"  chunk {c['chunk']}: {c['secs']:.4f}s (at +{c['t_rel']:.2f}s)"
+            )
+    for key in ("retries", "chaos", "watchdog", "degraded", "exhausted"):
+        if rep[key]:
+            tally = ", ".join(f"{s}={n}" for s, n in sorted(rep[key].items()))
+            lines.append(f"{key}: {tally}")
+    if rep["checkpoints"]:
+        lines.append(f"checkpoints saved: {rep['checkpoints']}")
+    if rep["last_incomplete"]:
+        li = rep["last_incomplete"]
+        lines.append(
+            f"last incomplete span: {li['name']} {li['attrs'] or ''} "
+            f"({li['elapsed_secs']:.3f}s elapsed, thread {li['thread']})"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="trace_report", description=__doc__)
+    ap.add_argument("trace", help="path to a <name>.<pid>.trace.jsonl file")
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    args = ap.parse_args(argv)
+    if not os.path.exists(args.trace):
+        print(f"trace_report: no such file: {args.trace}", file=sys.stderr)
+        return 2
+    rep = report(args.trace)
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(render_human(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
