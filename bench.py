@@ -338,6 +338,46 @@ def _trace_report_module():
     return mod
 
 
+def _prior_sync_p99(base: str | None) -> float | None:
+    """p99 healthy-sync duration from the most recent PRIOR bench round's
+    tfidf trace artifact under the persistent trace root (BENCH_TRACE_DIR).
+    None without a persistent root or a readable prior artifact — rounds
+    with an ephemeral tmpdir root can never see a prior round."""
+    if not base:
+        return None
+    import glob
+
+    me = os.path.join(base, f"run_{os.getpid()}")
+    paths = [
+        p
+        for p in glob.glob(os.path.join(base, "run_*", "tfidf.*.trace.jsonl"))
+        if not p.startswith(me + os.sep)
+    ]
+    if not paths:
+        return None
+    latest = max(paths, key=os.path.getmtime)
+    try:
+        p99 = _trace_report_module().sync_p99(latest)
+    except Exception as exc:  # a broken artifact must not block the bench
+        log(f"[deadline] unreadable prior trace {latest}: {exc}")
+        return None
+    if p99 is not None:
+        log(f"[deadline] prior-round sync p99 {p99:.3f}s ({latest})")
+    return p99
+
+
+def _effective_sync_deadline(knob_s: float, prior_p99_s: float | None) -> float:
+    """PR-3 armed a fixed 120 s child sync deadline; this re-validates it
+    against observed behavior: when a prior round's trace artifact exists,
+    the deadline is max(knob, 3 x that round's p99 sync span) — generous
+    enough that a tunnel merely being slow never trips the watchdog, tight
+    enough that a wedged sync dies in seconds-to-minutes, not at the
+    parent's 420 s kill.  knob 0 keeps the watchdog disabled."""
+    if knob_s <= 0 or prior_p99_s is None:
+        return knob_s
+    return max(knob_s, 3.0 * prior_p99_s)
+
+
 def _tfidf_trace_accounting(trace_dir: str) -> dict | None:
     """Per-phase accounting of the (latest) tfidf child from its trace
     artifact — works for healthy, resumed and timeout-killed children
@@ -477,18 +517,31 @@ def _main(graph_cache: str) -> int:
         # jax resolved to CPU on its own — no TPU plugin present
         log("backend resolved to cpu (no TPU plugin)")
     child_env = dict(os.environ)
+    sync_deadline_s: float | None = None
+    sync_deadline_source = "off"
     if tpu_alive:
         # Arm the resilience watchdog in every TPU child (ROADMAP PR-2
         # leftover): a hung host sync on the relay tunnel then surfaces as
         # a retryable SyncDeadlineExceeded inside the child instead of
-        # wedging it until the parent's 420 s kill.  Healthy syncs at this
-        # scale finish in well under a second; the default leaves >100x
-        # headroom.  Override with BENCH_SYNC_DEADLINE_S (0 disables); an
+        # wedging it until the parent's 420 s kill.  The deadline is
+        # ADAPTIVE: with a prior round's trace artifact under
+        # BENCH_TRACE_DIR, it becomes max(knob, 3 x that round's p99 sync
+        # span) — calibrated to the tunnel's observed behavior instead of
+        # a guess.  Override with BENCH_SYNC_DEADLINE_S (0 disables); an
         # explicit GRAFT_SYNC_DEADLINE_S in the parent env wins outright.
-        child_env.setdefault(
-            "GRAFT_SYNC_DEADLINE_S",
-            os.environ.get("BENCH_SYNC_DEADLINE_S", "120"),
-        )
+        if "GRAFT_SYNC_DEADLINE_S" in os.environ:
+            sync_deadline_s = float(os.environ["GRAFT_SYNC_DEADLINE_S"])
+            sync_deadline_source = "env"
+        else:
+            knob = float(os.environ.get("BENCH_SYNC_DEADLINE_S", "120"))
+            p99 = _prior_sync_p99(os.environ.get("BENCH_TRACE_DIR"))
+            sync_deadline_s = _effective_sync_deadline(knob, p99)
+            sync_deadline_source = (
+                "trace-p99" if sync_deadline_s > knob else "knob"
+            )
+            child_env["GRAFT_SYNC_DEADLINE_S"] = str(sync_deadline_s)
+        log(f"[deadline] child sync deadline {sync_deadline_s}s "
+            f"({sync_deadline_source})")
     else:
         log(f"TPU UNREACHABLE (probe={probe_out}); falling back to JAX-CPU "
             "for all measurements")
@@ -621,7 +674,14 @@ def _main(graph_cache: str) -> int:
     # parent time; a fixed-rate anchor is recorded by tools/ when needed) ---
     extra: dict = {"tpu_unreachable": not tpu_alive, "backend": backend_used,
                    "cpu_anchor_ips": round(cpu_ips, 2),
-                   "lint_clean": _lint_clean()}
+                   "lint_clean": _lint_clean(),
+                   # the sync deadline the children actually ran under
+                   # (None = watchdog not armed, CPU-fallback round) and
+                   # where it came from: "knob" (static default),
+                   # "trace-p99" (adapted from a prior round's artifact),
+                   # or "env" (explicit GRAFT_SYNC_DEADLINE_S)
+                   "sync_deadline_s": sync_deadline_s,
+                   "sync_deadline_source": sync_deadline_source}
     if tfidf_out:
         extra["tfidf_batch_tokens_per_sec"] = round(
             tfidf_out.get("batch_tokens_per_sec", 0.0))

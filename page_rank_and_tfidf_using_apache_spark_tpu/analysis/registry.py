@@ -144,6 +144,20 @@ def _build_pagerank_while_cumsum() -> Traceable:
     )
 
 
+def _shrink_chain(d0: int) -> list[int]:
+    """The device counts the elastic rung can rebuild onto from ``d0``:
+    the power-of-two shrink chain d0, d0/2, ..., 1 (resilience/elastic.py).
+    Every sharded entry traces each of them, so the semantic gates
+    (promotion, transfer census, collective budget) hold for the shrunk
+    meshes a degraded run executes on — not only the healthy shape."""
+    chain = []
+    d = d0
+    while d >= 1:
+        chain.append(d)
+        d //= 2
+    return chain
+
+
 def _sharded_pagerank_traceable(strategy: str) -> Traceable:
     import jax
 
@@ -157,25 +171,34 @@ def _sharded_pagerank_traceable(strategy: str) -> Traceable:
     )
     from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
 
-    d = min(4, len(jax.devices()))
-    mesh = make_mesh(d, NODES_AXIS)
     graph = synthetic_powerlaw(64, 256, seed=1)
     cfg = PageRankConfig(iterations=4, dangling="redistribute", init="uniform")
-    sg = ps.partition_graph(graph, d, strategy=strategy)
-    run = ps.make_sharded_runner(sg, cfg, mesh)
-    args = (
-        _f32((sg.n_pad,)),
-        _i32(sg.src.shape),
-        _i32(sg.dst.shape),
-        _f32(sg.valid.shape),
-        _i32(sg.local_indptr.shape),
-        _f32((sg.n_pad,)),
-        _f32((sg.n_pad,)),
-        _f32((sg.n_pad,)),
-    )
+    runners: dict[int, object] = {}
+    variants: list[tuple[str, tuple]] = []
+    for d in _shrink_chain(min(4, len(jax.devices()))):
+        mesh = make_mesh(d, NODES_AXIS)
+        sg = ps.partition_graph(graph, d, strategy=strategy)
+        runners[d] = ps.make_sharded_runner(sg, cfg, mesh)
+        args = (
+            _f32((sg.n_pad,)),
+            _i32(sg.src.shape),
+            _i32(sg.dst.shape),
+            _f32(sg.valid.shape),
+            _i32(sg.local_indptr.shape),
+            _f32((sg.n_pad,)),
+            _f32((sg.n_pad,)),
+            _f32((sg.n_pad,)),
+        )
+        variants.append((f"{strategy}-d{d}", args))
+
+    def dispatch(ranks, src, dst, valid, ip, inv, dang, e):
+        # per-device-count runners: the edge arrays are [d, e_dev], so the
+        # leading dim names which compiled program this variant exercises
+        return runners[src.shape[0]](ranks, src, dst, valid, ip, inv, dang, e)
+
     return Traceable(
-        fn=run,
-        variants=[(f"{strategy}-d{d}", args)],
+        fn=dispatch,
+        variants=variants,
         anchor=ps.make_sharded_runner,
     )
 
@@ -269,18 +292,25 @@ def _build_tfidf_sharded_ingest() -> Traceable:
         make_mesh,
     )
 
-    d = min(4, len(jax.devices()))
-    mesh = make_mesh(d, DATA_AXIS)
     cap, vocab = 2048, 1 << 10
-    kernel = ts.make_sharded_counts_kernel(mesh, vocab)
-    args = (
-        _i32((d, cap)),
-        _i32((d, cap)),
-        _sds((d, cap), np.bool_),
-    )
+    kernels: dict[int, object] = {}
+    variants: list[tuple[str, tuple]] = []
+    for d in _shrink_chain(min(4, len(jax.devices()))):
+        mesh = make_mesh(d, DATA_AXIS)
+        kernels[d] = ts.make_sharded_counts_kernel(mesh, vocab)
+        args = (
+            _i32((d, cap)),
+            _i32((d, cap)),
+            _sds((d, cap), np.bool_),
+        )
+        variants.append((f"d{d}-cap{cap}", args))
+
+    def dispatch(doc_ids, term_ids, valid):
+        return kernels[doc_ids.shape[0]](doc_ids, term_ids, valid)
+
     return Traceable(
-        fn=kernel,
-        variants=[(f"d{d}-cap{cap}", args)],
+        fn=dispatch,
+        variants=variants,
         anchor=ts.make_sharded_counts_kernel,
     )
 
@@ -354,6 +384,8 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         # one psum per iteration: the contribs combine (replicated state
         # needs no dangling-mass or delta collective)
         collective_budget=1,
+        # one compile per device count on the elastic shrink chain (4,2,1)
+        max_compiles=3,
     ),
     EntryPoint(
         name="pagerank_sharded_nodes_balanced",
@@ -368,6 +400,8 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         axes=("nodes",),
         # all_gather(weighted ranks) + psum(dangling mass) + psum(delta)
         collective_budget=3,
+        # one compile per device count on the elastic shrink chain (4,2,1)
+        max_compiles=3,
     ),
     EntryPoint(
         name="pagerank_sharded_src",
@@ -382,6 +416,8 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         axes=("nodes",),
         # reduce-scatter exchange + psum(dangling mass) + psum(delta)
         collective_budget=3,
+        # one compile per device count on the elastic shrink chain (4,2,1)
+        max_compiles=3,
     ),
     EntryPoint(
         name="tfidf_batch_pipeline",
@@ -412,6 +448,8 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         axes=("data",),
         # exactly the DF psum — the one reduceByKey of the ingest step
         collective_budget=1,
+        # one compile per device count on the elastic shrink chain (4,2,1)
+        max_compiles=3,
     ),
     EntryPoint(
         name="tfidf_finalize",

@@ -894,10 +894,10 @@ def check_unsynced_thread_state(ctx: FileContext) -> Iterator[Hit]:
 _knob_cache: dict[str, frozenset | None] = {}
 
 
-def _parse_declared_knobs(cfg_path) -> frozenset | None:
-    """Lexically extract the GRAFT_ENV_KNOBS literal from a config module
-    (never imports it — the linter must run even when the package is
-    broken).  None when the file has no declaration."""
+def _parse_declared_literal(cfg_path, name: str) -> frozenset | None:
+    """Lexically extract a string-literal collection assigned to ``name``
+    in a config module (never imports it — the linter must run even when
+    the package is broken).  None when the file has no declaration."""
     try:
         tree = ast.parse(cfg_path.read_text(encoding="utf-8"))
     except (OSError, SyntaxError):
@@ -912,7 +912,7 @@ def _parse_declared_knobs(cfg_path) -> frozenset | None:
             value = node.value
         else:
             continue
-        if any(isinstance(t, ast.Name) and t.id == "GRAFT_ENV_KNOBS" for t in targets):
+        if any(isinstance(t, ast.Name) and t.id == name for t in targets):
             return frozenset(
                 n.value
                 for n in ast.walk(value)
@@ -921,28 +921,36 @@ def _parse_declared_knobs(cfg_path) -> frozenset | None:
     return None
 
 
-def _declared_knobs(ctx: FileContext) -> frozenset | None:
+def _declared_config_literal(
+    ctx: FileContext, name: str, cache: dict
+) -> frozenset | None:
+    """Resolve ``name``'s declaration from the scanned tree's utils/
+    config.py, falling back to this package's own (snippet lints); cached
+    per lint root."""
     from pathlib import Path
 
     key = str(ctx.root) if ctx.root is not None else ""
-    if key in _knob_cache:
-        return _knob_cache[key]
+    if key in cache:
+        return cache[key]
     candidates = []
     if ctx.root is not None:
         candidates += [
             ctx.root / "page_rank_and_tfidf_using_apache_spark_tpu/utils/config.py",
             ctx.root / "utils/config.py",
         ]
-    # fall back to this package's own declaration (snippet lints)
     candidates.append(Path(__file__).resolve().parents[1] / "utils" / "config.py")
-    knobs = None
+    declared = None
     for c in candidates:
         if c.exists():
-            knobs = _parse_declared_knobs(c)
-            if knobs is not None:
+            declared = _parse_declared_literal(c, name)
+            if declared is not None:
                 break
-    _knob_cache[key] = knobs
-    return knobs
+    cache[key] = declared
+    return declared
+
+
+def _declared_knobs(ctx: FileContext) -> frozenset | None:
+    return _declared_config_literal(ctx, "GRAFT_ENV_KNOBS", _knob_cache)
 
 
 @rule(
@@ -993,6 +1001,102 @@ def check_env_knob_drift(ctx: FileContext) -> Iterator[Hit]:
             f"undeclared env knob {knob!r} ({where}) — declare it in "
             "GRAFT_ENV_KNOBS with a comment and document it in the README "
             "env-knob table before reading it",
+        )
+
+
+# --------------------------------------------------------------------------
+# 10. ladder-rung-drift
+# --------------------------------------------------------------------------
+
+_ladder_cache: dict[str, frozenset | None] = {}
+
+
+def _declared_ladder(ctx: FileContext) -> frozenset | None:
+    return _declared_config_literal(ctx, "DEGRADE_LADDER", _ladder_cache)
+
+
+def _degraded_ladder_kwargs(tree: ast.AST) -> Iterator[tuple[ast.AST, str]]:
+    """Every string-literal ``ladder=`` kwarg on an
+    ``emit("degraded", ...)`` / ``record(event="degraded", ...)`` call."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_degraded = any(
+            isinstance(a, ast.Constant) and a.value == "degraded"
+            for a in node.args
+        ) or any(
+            kw.arg == "event"
+            and isinstance(kw.value, ast.Constant)
+            and kw.value.value == "degraded"
+            for kw in node.keywords
+        )
+        if not is_degraded:
+            continue
+        for kw in node.keywords:
+            if (
+                kw.arg == "ladder"
+                and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, str)
+            ):
+                yield node, kw.value.value
+
+
+@rule(
+    "ladder-rung-drift",
+    "degradation-ladder drift against utils/config.py DEGRADE_LADDER: a "
+    "`degraded` event emitted with an undeclared ladder name, or a "
+    "declared rung no resilience/ module implements — the ladder the docs "
+    "promise and the ladder the code walks must be the same ladder",
+)
+def check_ladder_rung_drift(ctx: FileContext) -> Iterator[Hit]:
+    ladder = _declared_ladder(ctx)
+    if ctx.relpath.endswith("utils/config.py"):
+        # declaration side: every declared rung must be implemented — i.e.
+        # appear as a string literal somewhere under resilience/ (the
+        # subsystem that owns degradation).  Checked from the declaration
+        # site so the finding lands where the fix (or the deletion) goes.
+        if ladder is None or ctx.root is None:
+            return
+        res_dirs = [
+            ctx.root / "page_rank_and_tfidf_using_apache_spark_tpu/resilience",
+            ctx.root / "resilience",
+        ]
+        files = [p for d in res_dirs if d.is_dir() for p in d.glob("*.py")]
+        if not files:
+            return  # nothing to check against (snippet trees)
+        seen: set[str] = set()
+        for p in files:
+            try:
+                t = ast.parse(p.read_text(encoding="utf-8"))
+            except (OSError, SyntaxError):
+                continue
+            seen.update(
+                n.value
+                for n in ast.walk(t)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            )
+        for rung in sorted(ladder - seen):
+            yield (
+                ctx.tree,
+                f"declared rung {rung!r} is referenced nowhere under "
+                "resilience/ — implement the rung (it must publish a "
+                "`degraded` event) or drop it from DEGRADE_LADDER",
+            )
+        return
+
+    for node, name in _degraded_ladder_kwargs(ctx.tree):
+        if ladder is not None and name in ladder:
+            continue
+        where = (
+            "no DEGRADE_LADDER declaration found"
+            if ladder is None
+            else "not in utils/config.py DEGRADE_LADDER"
+        )
+        yield (
+            node,
+            f"`degraded` event emitted with undeclared ladder {name!r} "
+            f"({where}) — declare the rung in DEGRADE_LADDER (and the "
+            "README ladder table) before code may take it",
         )
 
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Callable
+from typing import Callable, NamedTuple
 
 import numpy as np
 
@@ -68,6 +68,20 @@ def resume_from_checkpoint(
     return start_iter
 
 
+class ElasticResult(NamedTuple):
+    """What an elastic shrink handler returns after it rebuilt the mesh
+    and ran the failed segment on the survivors: the segment outputs plus
+    the replacement callables every *subsequent* segment must use."""
+
+    ranks_dev: object
+    iters: int  # effective NEW iterations relative to the pre-failure count
+    delta: float
+    make_runner: Callable
+    invoke: Callable
+    extract_np: Callable
+    metrics_extra: dict  # merged into per-segment metrics (e.g. devices=N)
+
+
 def run_segments(
     cfg: PageRankConfig,
     metrics: MetricsRecorder,
@@ -80,6 +94,7 @@ def run_segments(
     segments_allowed: bool = True,
     extra_metrics: dict | None = None,
     make_cpu_invoke: Callable[[PageRankConfig], Callable] | None = None,
+    elastic_rebuild: Callable | None = None,
 ):
     """Run ``cfg.iterations`` in checkpoint-sized compiled segments.
 
@@ -92,12 +107,25 @@ def run_segments(
       ladder rung: a ``ranks_dev -> (ranks_dev, iters, delta)`` callable
       re-lowered for the CPU backend, run when on-device retries are
       exhausted or the device is lost.
+    - ``elastic_rebuild(exc, ranks_dev, done, seg_cfg)``, when given, is
+      the mesh-shrink rung for sharded runners: on device loss it salvages
+      the current state, rebuilds the mesh over the surviving devices,
+      repartitions, runs the failed segment there, and returns an
+      :class:`ElasticResult` whose callables replace this loop's (the
+      runner cache is dropped — every compiled program was welded to the
+      dead mesh).  It raises when it does not apply (not a device loss,
+      elastic disabled, nothing survives), passing the ladder on.
 
     Each segment dispatch runs under the resilience executor: transient
     failures retry with backoff (the runner is functional, so re-invoking
     with the same ranks cannot double-apply iterations), persistent ones
-    degrade to CPU, and exhaustion raises ``ResilienceExhausted`` carrying
-    the latest checkpoint under ``cfg.checkpoint_dir``.
+    walk the rungs above, and exhaustion raises ``ResilienceExhausted``
+    carrying the latest checkpoint under ``cfg.checkpoint_dir``.
+
+    Checkpoints are tagged with the segment's ``extra_metrics`` (the
+    sharded runners put ``devices=N`` there), so a snapshot records which
+    mesh shape wrote it — while staying readable across shrinks, because
+    the payload is always the logical ``n`` ranks.
 
     Returns ``(ranks_dev, done, last_delta)``.
     """
@@ -126,17 +154,34 @@ def run_segments(
         )
         if todo not in runners:
             runners[todo] = make_runner(seg_cfg)
-        fallback = None
+        rungs: list = []
+        if elastic_rebuild is not None:
+            def elastic_rung(exc, seg_cfg=seg_cfg, rd=ranks_dev):
+                # salvage + shrink + rerun happen in the handler; here we
+                # only swap this loop onto the rebuilt execution context
+                nonlocal make_runner, invoke, extract_np, extra_metrics
+                res: ElasticResult = elastic_rebuild(exc, rd, done, seg_cfg)
+                make_runner, invoke, extract_np = (
+                    res.make_runner, res.invoke, res.extract_np
+                )
+                extra_metrics = {**(extra_metrics or {}), **res.metrics_extra}
+                runners.clear()  # every cached program targeted the old mesh
+                cpu_invokes.clear()
+                return res.ranks_dev, res.iters, res.delta
+
+            rungs.append((None, elastic_rung))
         if make_cpu_invoke is not None:
-            def fallback(todo=todo, seg_cfg=seg_cfg, rd=ranks_dev):
+            def cpu_rung(_exc, todo=todo, seg_cfg=seg_cfg, rd=ranks_dev):
                 if todo not in cpu_invokes:
                     cpu_invokes[todo] = make_cpu_invoke(seg_cfg)
                 return cpu_invokes[todo](rd)
+
+            rungs.append(("cpu", cpu_rung))
         with Timer() as t, obs.span("pagerank.segment", start=done, todo=todo):
             ranks_dev, iters, delta = rx.run_guarded(
                 lambda r=runners[todo], rd=ranks_dev: invoke(r, rd),
                 site="pagerank_step", policy=policy, metrics=metrics,
-                checkpoint_dir=cfg.checkpoint_dir, fallback=fallback,
+                checkpoint_dir=cfg.checkpoint_dir, fallbacks=rungs,
             )
         done += int(iters)
         last_delta = float(delta)
@@ -153,6 +198,7 @@ def run_segments(
                 path = ckpt.save_checkpoint(
                     cfg.checkpoint_dir, done,
                     {"ranks": extract_np(ranks_dev)}, cfg.config_hash(),
+                    extra=dict(extra_metrics or {}),
                 )
             metrics.record(event="checkpoint", path=path, iter=done)
         if cfg.tol > 0.0:

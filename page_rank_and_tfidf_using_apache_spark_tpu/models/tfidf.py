@@ -187,10 +187,15 @@ def resume_ingest(cfg: TfidfConfig, metrics: MetricsRecorder) -> IngestState:
 
 
 def save_ingest_checkpoint(
-    cfg: TfidfConfig, metrics: MetricsRecorder, st: IngestState
+    cfg: TfidfConfig, metrics: MetricsRecorder, st: IngestState,
+    extra_meta: dict | None = None,
 ) -> None:
     """Snapshot accumulated ingest state, compacting the part lists in
-    place so host memory stays flat across checkpoints."""
+    place so host memory stays flat across checkpoints.  ``extra_meta``
+    rides along in the checkpoint metadata (the sharded path tags
+    ``devices=N`` so a snapshot records which mesh shape wrote it); the
+    payload itself is mesh-shape-independent — accumulated global DF and
+    TF parts — so any device count can resume from it."""
     doc_a, term_a, count_a = (np.concatenate(x) for x in zip(*st.parts))
     st.parts = [(doc_a, term_a, count_a)]
     st.doc_length_parts = [np.concatenate(st.doc_length_parts)]
@@ -206,6 +211,7 @@ def save_ingest_checkpoint(
             "n_docs": st.n_docs,
             "n_tokens": st.n_tokens,
             "ingest_secs": round(st.ingest_secs, 3),
+            **(extra_meta or {}),
         },
     )
     metrics.record(event="checkpoint", path=path, chunk=st.chunk_index)

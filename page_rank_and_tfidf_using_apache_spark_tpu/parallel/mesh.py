@@ -49,6 +49,34 @@ def make_mesh(
     return Mesh(np.array(devs), (axis,))
 
 
+def largest_pow2(n: int) -> int:
+    """Largest power of two <= n (0 for n < 1)."""
+    if n < 1:
+        return 0
+    return 1 << (n.bit_length() - 1)
+
+
+def shrink_devices(devices: Sequence[jax.Device]) -> list[jax.Device]:
+    """Truncate a surviving-device list to the largest power-of-two count.
+
+    The elastic rung (resilience/elastic.py) rebuilds meshes only at
+    power-of-two sizes: both sharded runners pad their partitions to the
+    device count, so halving the mesh at worst doubles per-device state —
+    the same bound the partition planners already budget for — while an
+    arbitrary shrink (say 8 -> 7) would produce a one-off shape that
+    recompiles without that guarantee.  Returns ``[]`` when nothing
+    survives (the caller falls through to the CPU rung)."""
+    return list(devices)[: largest_pow2(len(devices))]
+
+
+def rebuild_mesh(devices: Sequence[jax.Device], axis: str) -> Mesh:
+    """1-D mesh over exactly ``devices`` — the mesh-rebuild entry point the
+    elastic rung uses after :func:`shrink_devices` picked the survivors.
+    Identical to ``make_mesh(devices=...)``; named so call sites read as
+    what they are."""
+    return make_mesh(axis=axis, devices=devices)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

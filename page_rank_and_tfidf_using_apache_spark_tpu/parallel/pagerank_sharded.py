@@ -55,6 +55,7 @@ scale) — requesting it sharded raises.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from typing import NamedTuple
 
@@ -74,8 +75,11 @@ from page_rank_and_tfidf_using_apache_spark_tpu.parallel import collectives as c
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
     NODES_AXIS,
     make_mesh,
+    rebuild_mesh,
 )
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
     DanglingMode,
     PageRankConfig,
@@ -479,6 +483,120 @@ def device_put_sharded_graph(sg: ShardedGraph, mesh: Mesh):
     )
 
 
+class _ShardedExec:
+    """Everything welded to ONE mesh: the partition, the device-resident
+    graph arrays, the state sharding, and the callables run_segments
+    drives.  The elastic rung survives device loss by building a fresh
+    instance over the surviving mesh — nothing here is mutated."""
+
+    def __init__(self, graph: Graph, cfg: PageRankConfig, mesh: Mesh,
+                 strategy: str, metrics: MetricsRecorder):
+        self.mesh = mesh
+        self.d = int(mesh.devices.size)
+        with Timer() as t_part:
+            self.sg = partition_graph(
+                graph, self.d, strategy=strategy, dtype=cfg.dtype,
+                need_local_indptr=cfg.spmv_impl in ("cumsum", "cumsum_mxu"),
+            )
+            self.dev = device_put_sharded_graph(self.sg, mesh)
+        metrics.record(
+            event="partition", strategy=strategy, devices=self.d,
+            block=self.sg.block, edges_per_device=int(self.sg.src.shape[1]),
+            pad_frac=round(self.sg.pad_frac, 4), secs=t_part.elapsed,
+        )
+        axis = mesh.axis_names[0]
+        self.state_sharding = (
+            NamedSharding(mesh, P()) if self.sg.strategy == "edges"
+            else NamedSharding(mesh, P(axis))
+        )
+        self.e_vec = jax.device_put(_restart_padded(self.sg, cfg),
+                                    self.state_sharding)
+        self._cfg = cfg
+        self._metrics = metrics
+
+    def make_runner(self, seg_cfg: PageRankConfig):
+        return make_sharded_runner(self.sg, seg_cfg, self.mesh)
+
+    def invoke(self, runner, rd):
+        rd, iters, delta = runner(rd, *self.dev, self.e_vec)
+        delta = float(delta)  # scalar fetch is the only reliable device sync
+        return rd, iters, delta
+
+    def put_ranks(self, ranks_g: np.ndarray):
+        """Global [n] ranks -> padded, sharded device state."""
+        return jax.device_put(
+            _to_padded(self.sg, ranks_g, self._cfg.dtype), self.state_sharding
+        )
+
+    def extract_np(self, rd) -> np.ndarray:
+        """Padded device state -> global [n] ranks (checkpoint payload)."""
+        with obs.span("pagerank.ckpt_pull"):
+            return rx.device_get(
+                rd, site="pagerank_ckpt_pull", metrics=self._metrics,
+                checkpoint_dir=self._cfg.checkpoint_dir,
+            )[self.sg.node_map]
+
+
+def _make_elastic_rebuild(graph: Graph, cfg: PageRankConfig, strategy: str,
+                          metrics: MetricsRecorder, exec_box: dict):
+    """The mesh-shrink rung for run_segments (driver.ElasticResult
+    contract): salvage the global ranks, checkpoint them, rebuild the mesh
+    over the surviving devices (the ``nodes_balanced`` planner re-balances
+    its edge splits for the new count), and rerun the failed segment with
+    zero recomputed *committed* iterations."""
+
+    def rebuild(exc, ranks_dev, done, seg_cfg):
+        if not elastic.enabled() or not elastic.is_device_loss(exc):
+            raise exc
+        idx = elastic.device_index(exc)
+        if idx is not None:
+            elastic.health().mark_lost(idx)
+        old = exec_box["exec"]
+        # (1) salvage state at the last committed iteration: live buffers
+        # first (survivor shards are usually intact), else the newest
+        # checkpoint — both carry the logical [n] ranks, so they read the
+        # same across mesh shapes.
+        try:
+            ranks_g, at_iter = old.extract_np(ranks_dev), done
+        except Exception:
+            latest = (ckpt.latest_checkpoint(cfg.checkpoint_dir)
+                      if cfg.checkpoint_dir else None)
+            if latest is None:
+                raise exc
+            step, arrays, _ = ckpt.load_checkpoint(latest, cfg.config_hash())
+            ranks_g, at_iter = arrays["ranks"], int(step)
+        if cfg.checkpoint_dir:
+            ckpt.save_checkpoint(
+                cfg.checkpoint_dir, at_iter, {"ranks": ranks_g},
+                cfg.config_hash(), extra={"devices": old.d},
+            )
+        # (2) plan + build the surviving mesh
+        plan = elastic.plan_shrink(list(old.mesh.devices.flat))
+        if plan is None:
+            raise exc
+        with elastic.publish_shrink("pagerank_step", plan, exc, metrics):
+            # keep the dying mesh's axis name: a caller-provided mesh may
+            # not be named NODES_AXIS, and the runner/shardings are built
+            # from whatever the mesh declares
+            new_mesh = rebuild_mesh(plan.devices, old.mesh.axis_names[0])
+            # (3) repartition for the survivors
+            new = _ShardedExec(graph, cfg, new_mesh, strategy, metrics)
+            rd2 = new.put_ranks(ranks_g)
+        # (4) resume: rerun this segment's span from the salvage point —
+        # committed iterations (< at_iter) are never recomputed
+        todo2 = done - at_iter + seg_cfg.iterations
+        seg_cfg2 = dataclasses.replace(seg_cfg, iterations=todo2)
+        rd2, iters, delta = new.invoke(new.make_runner(seg_cfg2), rd2)
+        exec_box["exec"] = new
+        effective = at_iter + int(iters) - done
+        return driver.ElasticResult(
+            rd2, effective, delta, new.make_runner, new.invoke,
+            new.extract_np, {"devices": new.d},
+        )
+
+    return rebuild
+
+
 def run_pagerank_sharded(
     graph: Graph,
     cfg: PageRankConfig,
@@ -492,7 +610,12 @@ def run_pagerank_sharded(
     """Sharded counterpart of models.pagerank.run_pagerank — same semantics
     flags, same checkpoint segments, ranks bit-comparable across device
     counts up to float reduction order (chip-count invariance is pinned by
-    tests/test_parallel.py)."""
+    tests/test_parallel.py).
+
+    Device loss no longer aborts the run: the elastic rung (resilience/
+    elastic.py) shrinks the mesh onto the surviving devices, repartitions,
+    and resumes — falling through to ``ResilienceExhausted`` + checkpoint
+    only when nothing survives or ``GRAFT_ELASTIC=0``."""
     ensure_dtype_support(cfg.dtype)
     metrics = metrics or MetricsRecorder()
     if mesh is None:
@@ -505,56 +628,37 @@ def run_pagerank_sharded(
         metrics.record(event="auto_strategy", chosen=strategy, devices=d)
     cfg = driver.resolve_personalize(graph, cfg)
 
-    with Timer() as t_part:
-        sg = partition_graph(
-            graph, d, strategy=strategy, dtype=cfg.dtype,
-            need_local_indptr=cfg.spmv_impl in ("cumsum", "cumsum_mxu"),
-        )
-        dev = device_put_sharded_graph(sg, mesh)
-    metrics.record(
-        event="partition", strategy=strategy, devices=d, block=sg.block,
-        edges_per_device=int(sg.src.shape[1]), pad_frac=round(sg.pad_frac, 4),
-        secs=t_part.elapsed,
+    exec_ = _ShardedExec(graph, cfg, mesh, strategy, metrics)
+    ranks_g = ops.init_ranks(exec_.sg.n, cfg)
+    start_iter = (
+        driver.resume_from_checkpoint(cfg, metrics, ranks_g, n=exec_.sg.n)
+        if resume else 0
     )
-
-    axis = mesh.axis_names[0]
-    state_sharding = (
-        NamedSharding(mesh, P()) if sg.strategy == "edges" else NamedSharding(mesh, P(axis))
-    )
-    e_vec = jax.device_put(_restart_padded(sg, cfg), state_sharding)
-    ranks_g = ops.init_ranks(sg.n, cfg)
-    start_iter = driver.resume_from_checkpoint(cfg, metrics, ranks_g, n=sg.n) if resume else 0
-    ranks_dev = jax.device_put(_to_padded(sg, ranks_g, cfg.dtype), state_sharding)
-
-    def invoke(runner, rd):
-        rd, iters, delta = runner(rd, *dev, e_vec)
-        delta = float(delta)  # scalar fetch is the only reliable device sync
-        return rd, iters, delta
+    ranks_dev = exec_.put_ranks(ranks_g)
 
     # No make_cpu_invoke here: the compiled program is welded to the mesh
     # (collectives over its axis), so there is no single-device re-lowering
-    # to degrade to.  Exhausted retries raise ResilienceExhausted carrying
-    # the checkpoint; rerunning with --mesh 0 --resume IS the degraded path.
-    def extract_np(rd):
-        with obs.span("pagerank.ckpt_pull"):
-            return rx.device_get(
-                rd, site="pagerank_ckpt_pull", metrics=metrics,
-                checkpoint_dir=cfg.checkpoint_dir,
-            )[sg.node_map]
-
+    # of the SAME program to degrade to.  The elastic rung is the sharded
+    # degradation path: rebuild over survivors down to a 1-device mesh
+    # (which the CPU backend can host when the accelerator pool is gone).
+    exec_box = {"exec": exec_}
     ranks_dev, done, last_delta = driver.run_segments(
         cfg, metrics, ranks_dev, start_iter,
-        make_runner=lambda seg_cfg: make_sharded_runner(sg, seg_cfg, mesh),
-        invoke=invoke,
-        extract_np=extract_np,
+        make_runner=exec_.make_runner,
+        invoke=exec_.invoke,
+        extract_np=exec_.extract_np,
         extra_metrics={"devices": d},
+        elastic_rebuild=_make_elastic_rebuild(
+            graph, cfg, strategy, metrics, exec_box
+        ),
     )
+    exec_ = exec_box["exec"]  # the elastic rung may have swapped it
     with obs.span("pagerank.result_pull"):
         ranks_np = rx.device_get(
             ranks_dev, site="pagerank_result_pull", metrics=metrics,
             checkpoint_dir=cfg.checkpoint_dir,
         )
     return PageRankResult(
-        ranks=ranks_np[sg.node_map], iterations=done,
+        ranks=ranks_np[exec_.sg.node_map], iterations=done,
         l1_delta=last_delta, metrics=metrics,
     )

@@ -38,9 +38,14 @@ from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
     save_ingest_checkpoint,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel import collectives as coll
-from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
+    DATA_AXIS,
+    make_mesh,
+    rebuild_mesh,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, ensure_dtype_support
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 
@@ -123,7 +128,6 @@ def run_tfidf_sharded(
             if item is None:
                 break
             _, corpus = item
-            st.n_docs += corpus.n_docs
             group.append(corpus)
         if not group:
             break
@@ -134,6 +138,10 @@ def run_tfidf_sharded(
         if kernel is None:
             kernel = make_sharded_counts_kernel(mesh, vocab)
 
+        # st is NOT touched until the pull commits below: the elastic rung
+        # may checkpoint st mid-group, and a snapshot must only ever hold
+        # fully-committed chunks (n_docs for an uncommitted group would
+        # poison the resume-side chunking validation).
         doc_ids = np.zeros((d, cap), np.int32)
         term_ids = np.zeros((d, cap), np.int32)
         valid = np.zeros((d, cap), bool)
@@ -141,7 +149,72 @@ def run_tfidf_sharded(
             doc_ids[i, : c.n_tokens] = c.doc_ids
             term_ids[i, : c.n_tokens] = c.term_ids
             valid[i, : c.n_tokens] = True
-            st.doc_length_parts.append(c.doc_lengths)
+
+        def elastic_reslice(exc, doc_ids=doc_ids, term_ids=term_ids,
+                            valid=valid):
+            """Mesh-shrink rung: on device loss, checkpoint the committed
+            ingest state, rebuild the mesh/kernel over the survivors, and
+            re-slice the in-flight super-chunk (never-committed work) into
+            new-width dispatches.  Committed chunks are untouched — zero
+            reprocessing, same guarantee as the resume path."""
+            nonlocal mesh, d, esh, kernel, last_ckpt
+            if not elastic.enabled() or not elastic.is_device_loss(exc):
+                raise exc
+            idx = elastic.device_index(exc)
+            if idx is not None:
+                elastic.health().mark_lost(idx)
+            if cfg.checkpoint_dir and st.parts:
+                st.ingest_secs = secs0 + (time.perf_counter() - run_started)
+                save_ingest_checkpoint(cfg, metrics, st,
+                                       extra_meta={"devices": d})
+                last_ckpt = st.chunk_index
+            plan = elastic.plan_shrink(list(mesh.devices.flat))
+            if plan is None:
+                raise exc
+            with elastic.publish_shrink("tfidf_shard_sync", plan, exc,
+                                        metrics):
+                # keep the dying mesh's axis name: a caller-provided mesh
+                # may not be named DATA_AXIS, and esh below is built from
+                # the same ``axis``
+                mesh = rebuild_mesh(plan.devices, axis)
+                d = plan.new_count
+                esh = NamedSharding(mesh, P(axis, None))
+                kernel = make_sharded_counts_kernel(mesh, vocab)
+            rows = doc_ids.shape[0]
+            outs: list[tuple] = []
+            df_sum = None
+            with obs.span("tfidf.reslice", rows=rows, width=d):
+                for lo in range(0, rows, d):
+                    batch = slice(lo, lo + d)
+                    b_doc = np.zeros((d, cap), np.int32)
+                    b_term = np.zeros((d, cap), np.int32)
+                    b_valid = np.zeros((d, cap), bool)
+                    n_rows = doc_ids[batch].shape[0]
+                    b_doc[:n_rows] = doc_ids[batch]
+                    b_term[:n_rows] = term_ids[batch]
+                    b_valid[:n_rows] = valid[batch]
+                    (r_doc, r_term, r_cnt, r_np, _rv), r_df = kernel(
+                        jax.device_put(b_doc, esh),
+                        jax.device_put(b_term, esh),
+                        jax.device_put(b_valid, esh),
+                    )
+                    # one batched pull per re-sliced dispatch: the shrunk
+                    # mesh processes the in-flight rows sequentially, so
+                    # each sub-dispatch syncs before the next launches
+                    h = rx.device_get(  # graftlint: disable=host-sync-in-loop (one batched pull per re-sliced dispatch on the rare shrink path)
+                        (r_doc, r_term, r_cnt, r_np, r_df),
+                        site="tfidf_shard_sync", metrics=metrics,
+                        checkpoint_dir=cfg.checkpoint_dir,
+                    )
+                    outs.append(h[:4])
+                    df_sum = h[4] if df_sum is None else df_sum + h[4]
+            return (
+                np.concatenate([o[0] for o in outs]),
+                np.concatenate([o[1] for o in outs]),
+                np.concatenate([o[2] for o in outs]),
+                np.concatenate([np.atleast_1d(o[3]).ravel() for o in outs]),
+                df_sum,
+            )
 
         with Timer() as t, obs.span("tfidf.super_chunk", step=step,
                                     chunk=st.chunk_index):
@@ -154,21 +227,25 @@ def run_tfidf_sharded(
             # super-chunk instead of a block_until_ready fence plus four
             # separate np.asarray transfers (each paying tunnel RTT).
             # Guarded: a transient failure re-issues the pull against the
-            # live buffers; exhaustion carries the chunk checkpoint.
+            # live buffers; device loss shrinks the mesh (elastic rung);
+            # exhaustion carries the chunk checkpoint.
             h_doc, h_term, h_cnt, n_pairs, h_df = rx.device_get(
                 (c_doc, c_term, c_cnt, c_np, df),
                 site="tfidf_shard_sync", metrics=metrics,
                 checkpoint_dir=cfg.checkpoint_dir,
+                fallbacks=[(None, elastic_reslice)],
             )
         st.df_total = st.df_total + h_df.astype(dtype)
         n_pairs = n_pairs.ravel()
-        for i in range(len(group)):
+        for i, c in enumerate(group):
             k = int(n_pairs[i])
             # .copy() so parts holds k-sized arrays, not views pinning the
             # whole (d, cap) transfer buffer until finalize
             st.parts.append(
                 (h_doc[i, :k].copy(), h_term[i, :k].copy(), h_cnt[i, :k].copy())
             )
+            st.doc_length_parts.append(c.doc_lengths)
+        st.n_docs += int(sum(c.n_docs for c in group))
         st.chunk_index += len(group)
         st.n_tokens += int(sum(c.n_tokens for c in group))
         metrics.record(
@@ -181,7 +258,8 @@ def run_tfidf_sharded(
             and st.chunk_index - last_ckpt >= cfg.checkpoint_every
         ):
             st.ingest_secs = secs0 + (time.perf_counter() - run_started)
-            save_ingest_checkpoint(cfg, metrics, st)
+            save_ingest_checkpoint(cfg, metrics, st,
+                                   extra_meta={"devices": d})
             last_ckpt = st.chunk_index
 
     return finalize_tfidf(st, cfg, metrics)

@@ -1,6 +1,7 @@
 """Resilient execution runtime: deterministic fault injection
-(:mod:`.chaos`), a retry/deadline executor with a CPU degradation ladder
-(:mod:`.executor`), and the structured :class:`ResilienceExhausted` that
+(:mod:`.chaos`), a retry/deadline executor walking a declared degradation
+ladder (:mod:`.executor`), elastic mesh shrink-and-resume on device loss
+(:mod:`.elastic`), and the structured :class:`ResilienceExhausted` that
 hands callers the checkpoint to resume from.  See README "Failure model
 and recovery" for the contract."""
 
@@ -9,6 +10,12 @@ from page_rank_and_tfidf_using_apache_spark_tpu.resilience.chaos import (
     DeviceLostError,
     inject,
     parse_plan,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience.elastic import (
+    DeviceHealth,
+    ShrinkPlan,
+    plan_shrink,
+    reset_health,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience.executor import (
     ResilienceExhausted,
@@ -22,14 +29,18 @@ from page_rank_and_tfidf_using_apache_spark_tpu.resilience.executor import (
 
 __all__ = [
     "ChaosError",
+    "DeviceHealth",
     "DeviceLostError",
     "ResilienceExhausted",
     "RetryPolicy",
+    "ShrinkPlan",
     "SyncDeadlineExceeded",
     "block_until_ready",
     "device_get",
     "inject",
     "is_transient",
     "parse_plan",
+    "plan_shrink",
+    "reset_health",
     "run_guarded",
 ]

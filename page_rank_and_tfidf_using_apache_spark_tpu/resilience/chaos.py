@@ -24,10 +24,19 @@ Plan specification — the ``GRAFT_CHAOS`` env var or :func:`inject`::
            hang  - sleep <param> seconds (default 3600) before returning,
                    simulating a hung device_get; only a sync deadline
                    (GRAFT_SYNC_DEADLINE_S) interrupts it
+           device_lost - kill ONE logical device: raise DeviceLostError
+                   carrying ``.device = K`` on every matching guarded call
+                   until the elastic runtime (resilience/elastic.py)
+                   acknowledges the loss by marking device K dead — exactly
+                   how a real dead chip behaves: every touch fails until
+                   the scheduler stops scheduling onto it.  Spelled
+                   ``device_lost@dev:K`` (K = index into jax.devices()).
     when   N     the Nth guarded call at this site (1-based), exactly once
            N+    every call from the Nth on
            %K    every Kth call (K, 2K, 3K, ...)
-    param  seconds, for hang
+           dev   (device_lost only) every call while device <param> is
+                 still considered healthy
+    param  seconds for hang; the logical device index for device_lost
 
 Examples::
 
@@ -35,6 +44,9 @@ Examples::
     GRAFT_CHAOS="tfidf_chunk_sync:lost@26"      # kill the 26th chunk drain
     GRAFT_CHAOS="*:fail@%5"                     # every 5th guarded call
                                                 # fails once (chaos.sh)
+    GRAFT_CHAOS="*:device_lost@dev:1"           # logical device 1 dies; a
+                                                # sharded run must shrink
+                                                # its mesh to survive
 
 Counters are per *actual* site name and live on the installed plan, so one
 plan == one deterministic schedule.  Everything is thread-safe: guarded
@@ -59,7 +71,15 @@ class ChaosError(RuntimeError):
 
 class DeviceLostError(RuntimeError):
     """Injected *persistent* device loss — retrying on the same device
-    cannot help; only degradation or restart-from-snapshot can."""
+    cannot help; only degradation or restart-from-snapshot can.
+
+    ``device`` names the lost logical device index (into ``jax.devices()``)
+    when the fault targets one device (kind ``device_lost``); None means
+    the whole backend is gone (kind ``lost``)."""
+
+    def __init__(self, message: str, device: int | None = None):
+        super().__init__(message)
+        self.device = device
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +93,10 @@ class Injection:
         if self.site != "*" and self.site != site:
             return False
         w = self.when
+        if w == "dev":
+            # device_lost: fires on every call; gated at injection time on
+            # whether the target device is still considered healthy
+            return True
         if w.startswith("%"):
             k = int(w[1:])
             return k > 0 and count % k == 0
@@ -95,8 +119,19 @@ def parse_plan(spec: str) -> tuple[Injection, ...]:
         if "@" not in action:
             raise ValueError(f"bad chaos injection {raw!r}: missing @when")
         kind, when = action.split("@", 1)
-        if kind not in ("fail", "lost", "hang"):
+        if kind not in ("fail", "lost", "hang", "device_lost"):
             raise ValueError(f"bad chaos kind {kind!r} in {raw!r}")
+        if kind == "device_lost":
+            # grammar: site:device_lost@dev:K — the device index rides in
+            # the param slot, and "dev" is the only legal schedule token
+            if when != "dev" or len(parts) != 3 or not parts[2].isdigit():
+                raise ValueError(
+                    f"bad chaos injection {raw!r}: device_lost is spelled "
+                    "site:device_lost@dev:<device-index>"
+                )
+            out.append(Injection(site=site, kind=kind, when=when,
+                                 param=float(int(parts[2]))))
+            continue
         m = re.fullmatch(r"%(\d+)|(\d+)\+?", when)
         if m is None or int(m.group(1) or m.group(2)) < 1:
             raise ValueError(f"bad chaos schedule {when!r} in {raw!r}")
@@ -126,6 +161,27 @@ class ChaosPlan:
         for inj in self.injections:
             if not inj.matches(site, count):
                 continue
+            if inj.kind == "device_lost":
+                # Fires only while the target device is still believed
+                # healthy: once the elastic runtime acknowledges the loss
+                # (resilience/elastic.py marks it dead and the mesh no
+                # longer schedules onto it), touching the survivors
+                # succeeds again.  Lazy import — elastic imports this
+                # module at load time.
+                from page_rank_and_tfidf_using_apache_spark_tpu.resilience import (
+                    elastic,
+                )
+
+                dev = int(inj.param)
+                if elastic.health().is_lost(dev):
+                    continue
+                obs.emit("chaos", site=site, fault=inj.kind, call=count,
+                         device=dev)
+                obs.counter("chaos_injections")
+                raise DeviceLostError(
+                    f"chaos: device {dev} lost at {site} call #{count}",
+                    device=dev,
+                )
             # published BEFORE the fault takes effect: the injection must be
             # on record even when it hangs or kills the run it fires in
             obs.emit("chaos", site=site, fault=inj.kind, call=count)

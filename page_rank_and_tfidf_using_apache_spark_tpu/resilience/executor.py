@@ -2,10 +2,13 @@
 
 Spark's resilience came from lineage recomputation; on TPU the equivalents
 are (in escalation order) **retry** the failed dispatch/sync on-device,
-**degrade** the segment to a freshly-lowered CPU executable, and finally
-**resume** from the last atomic checkpoint (utils/checkpoint.py).  This
-module implements the first two rungs and hands the third to callers as a
-structured :class:`ResilienceExhausted` carrying the latest checkpoint path.
+**degrade** — shrink a sharded mesh onto the surviving devices
+(resilience/elastic.py) or re-lower a single-chip segment for the CPU
+backend — and finally **resume** from the last atomic checkpoint
+(utils/checkpoint.py).  This module implements retry plus the generic
+rung-walking (``fallbacks``), and hands the terminal state to callers as a
+structured :class:`ResilienceExhausted` carrying the latest checkpoint
+path.  Rung names are declared in ``utils/config.DEGRADE_LADDER``.
 
 Every long-running path (models/driver.py segments, the streaming and
 sharded TF-IDF chunk drains) routes its host round-trips through
@@ -181,13 +184,20 @@ def run_guarded(
     metrics: MetricsRecorder | None = None,
     checkpoint_dir: str | None = None,
     fallback: Callable[[], Any] | None = None,
+    fallbacks: "list[tuple[str | None, Callable[[BaseException], Any]]] | None" = None,
 ) -> Any:
     """Run ``fn`` under the full degradation ladder.
 
     1. up to ``policy.max_retries`` retries with exponential backoff, for
        transient failures only;
-    2. one shot at ``fallback`` (the caller's re-lowered CPU executable),
-       for persistent failures or an exhausted retry budget;
+    2. the ``fallbacks`` rungs in order — each a ``(ladder, fn(exc))``
+       pair.  A named rung publishes the ``degraded`` event here before
+       running (``ladder`` must be declared in utils/config.DEGRADE_LADDER
+       — the lint gate); ``ladder=None`` hands emission to the rung
+       itself, for rungs like the elastic mesh shrink that only *decide*
+       whether they apply (and what they degraded to) once they inspect
+       the failure.  A rung that raises passes the ladder to the next.
+       ``fallback=`` is legacy sugar for one trailing no-arg ``cpu`` rung.
     3. :class:`ResilienceExhausted` carrying the latest checkpoint under
        ``checkpoint_dir`` so the caller (or the operator) can resume.
 
@@ -227,19 +237,23 @@ def run_guarded(
                      secs=round(delay, 4))
             obs.histogram("backoff_secs", delay)
 
+    rungs = list(fallbacks or [])
     if fallback is not None:
-        err = f"{type(last_exc).__name__}: {last_exc}"[:200]
-        obs.emit("degraded", site=site, ladder="cpu", after_attempts=attempts,
-                 error=err)
-        obs.counter("degraded")
-        if metrics is not None:
-            metrics.record(
-                event="degraded", site=site, ladder="cpu",
-                after_attempts=attempts, error=err,
-            )
+        rungs.append(("cpu", lambda _exc, _fb=fallback: _fb()))
+    for ladder, rung_fn in rungs:
+        if ladder is not None:
+            err = f"{type(last_exc).__name__}: {last_exc}"[:200]
+            obs.emit("degraded", site=site, ladder=ladder,
+                     after_attempts=attempts, error=err)
+            obs.counter("degraded")
+            if metrics is not None:
+                metrics.record(
+                    event="degraded", site=site, ladder=ladder,
+                    after_attempts=attempts, error=err,
+                )
         try:
-            return fallback()
-        except Exception as exc:  # terminal rung; interrupts propagate
+            return rung_fn(last_exc)
+        except Exception as exc:  # try the next rung; interrupts propagate
             last_exc = exc
 
     assert last_exc is not None
@@ -260,16 +274,18 @@ def device_get(
     policy: RetryPolicy | None = None,
     metrics: MetricsRecorder | None = None,
     checkpoint_dir: str | None = None,
+    fallbacks: "list[tuple[str | None, Callable[[BaseException], Any]]] | None" = None,
 ) -> Any:
     """Guarded ``jax.device_get``: ONE batched device->host pull per call
     (keep the VERDICT r5 single-round-trip discipline), retried/deadlined
     by the executor.  Device buffers outlive a failed pull, so re-issuing
-    the transfer is always safe."""
+    the transfer is always safe.  ``fallbacks`` rungs (e.g. the sharded
+    runners' elastic mesh shrink) apply exactly as in :func:`run_guarded`."""
     import jax
 
     return run_guarded(
         lambda: jax.device_get(tree), site=site, policy=policy,
-        metrics=metrics, checkpoint_dir=checkpoint_dir,
+        metrics=metrics, checkpoint_dir=checkpoint_dir, fallbacks=fallbacks,
     )
 
 

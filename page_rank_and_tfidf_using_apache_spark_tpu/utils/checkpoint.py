@@ -9,6 +9,14 @@ Format: flat ``.npz`` (numpy) plus a JSON sidecar — deliberately dependency
 -free and host-readable.  Writes are atomic (tmp file + rename) so a kill
 mid-write never corrupts the latest checkpoint; the fault-injection test in
 ``tests/test_checkpoint.py`` exercises exactly that.
+
+Mesh-shape tagging (ISSUE 5): sharded callers put ``devices=N`` in
+``extra`` so a snapshot records which mesh wrote it, but the *payload* is
+always logical global state (the [n] rank vector, the accumulated DF/TF
+parts) — never per-device shards.  That is what makes checkpoints readable
+across elastic mesh shrinks: a snapshot written by an 8-device run resumes
+on 4, 1, or the CPU backend unchanged, and ``config_hash`` rightly ignores
+topology because device count is operational, not semantic.
 """
 
 from __future__ import annotations

@@ -24,6 +24,8 @@ from typing import Any
 GRAFT_ENV_KNOBS: frozenset = frozenset(
     {
         "GRAFT_CHAOS",  # fault-injection plan (resilience/chaos.py)
+        "GRAFT_ELASTIC",  # elastic mesh degradation on device loss
+        # (resilience/elastic.py; "0" disables the mesh-shrink rung)
         "GRAFT_RETRY_MAX",  # max retries per guarded call
         "GRAFT_SYNC_DEADLINE_S",  # watchdog deadline for host syncs
         "GRAFT_STEP_DEADLINE_S",  # watchdog deadline for segment dispatch
@@ -36,6 +38,23 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         "GRAFT_TRACE_DIR",  # obs/ run-telemetry output dir: traced runs write
         # <name>.<pid>.trace.jsonl + .manifest.json here (unset = no trace)
     }
+)
+
+
+# The degradation rungs a guarded path may take past retry, declared in one
+# place like the env knobs above.  graftlint's ``ladder-rung-drift`` rule
+# fails on any ``obs.emit("degraded", ladder=<literal>)`` whose rung is not
+# listed here, and on any declared rung that no resilience/ module
+# implements — the ladder the README documents and the ladder the code
+# walks cannot drift apart.  Parsed lexically by the linter — keep it a
+# literal.  Full escalation order (README "Failure model and recovery"):
+# retry -> mesh_shrink -> single_device -> cpu -> exhausted; retry and
+# exhausted publish their own event kinds, so only the degradation rungs
+# between them are ladder names.
+DEGRADE_LADDER: tuple = (
+    "mesh_shrink",  # rebuild the mesh over surviving devices (pow2 shrink)
+    "single_device",  # the 1-device end of the shrink chain
+    "cpu",  # re-lower on the CPU backend (single-chip paths)
 )
 
 

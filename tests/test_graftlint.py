@@ -660,6 +660,66 @@ def test_env_knob_suppressed(tmp_path):
     )
 
 
+LADDER_TP = """
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+def degrade():
+    obs.emit("degraded", site="x", ladder="warp_drive", after_attempts=2)
+"""
+
+LADDER_TN = """
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+def degrade(rung):
+    obs.emit("degraded", site="x", ladder="cpu", after_attempts=2)
+    obs.emit("degraded", site="x", ladder=rung)  # computed: checked at the
+    # declaration side, not here
+    obs.emit("retry", site="x", ladder="warp_drive")  # not a degraded event
+"""
+
+LADDER_SUPPRESSED = """
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+def degrade():
+    obs.emit("degraded", site="x", ladder="warp_drive")  # graftlint: disable=ladder-rung-drift (migration shim)
+"""
+
+
+def test_ladder_rung_true_positive(tmp_path):
+    assert "ladder-rung-drift" in rules_hit(lint_snippet(tmp_path, LADDER_TP))
+
+
+def test_ladder_rung_true_negative(tmp_path):
+    assert "ladder-rung-drift" not in rules_hit(lint_snippet(tmp_path, LADDER_TN))
+
+
+def test_ladder_rung_suppressed(tmp_path):
+    assert "ladder-rung-drift" not in rules_hit(
+        lint_snippet(tmp_path, LADDER_SUPPRESSED)
+    )
+
+
+def test_ladder_rung_declaration_coverage(tmp_path):
+    """The declaration side: a DEGRADE_LADDER rung no resilience/ module
+    references is drift, flagged at the declaration."""
+    cfg_dir = tmp_path / "utils"
+    cfg_dir.mkdir()
+    cfg = cfg_dir / "config.py"
+    cfg.write_text('DEGRADE_LADDER = ("zeta",)\n')
+    res_dir = tmp_path / "resilience"
+    res_dir.mkdir()
+    (res_dir / "impl.py").write_text('LADDER = "other"\n')
+    findings = lint_file(cfg, tmp_path)
+    assert "ladder-rung-drift" in {f.rule for f in findings}
+
+    (res_dir / "impl.py").write_text('LADDER = "zeta"\n')
+    import page_rank_and_tfidf_using_apache_spark_tpu.analysis.rules as rules_mod
+
+    rules_mod._ladder_cache.clear()  # per-root cache from the first pass
+    findings = lint_file(cfg, tmp_path)
+    assert "ladder-rung-drift" not in {f.rule for f in findings}
+
+
 def test_env_knob_reads_local_declaration(tmp_path):
     """A scanned tree's own utils/config.py declaration wins over the
     package fallback."""
@@ -715,6 +775,7 @@ def test_every_rule_has_summary():
         "untraced-guarded-site",
         "unsynced-thread-state",
         "env-knob-drift",
+        "ladder-rung-drift",
     }
     for rule in RULES.values():
         assert rule.summary

@@ -303,14 +303,17 @@ def test_pagerank_exhausted_resumes_from_checkpoint(tmp_path):
     np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
 
 
-def test_pagerank_sharded_exhausted_then_resume(tmp_path):
-    """The sharded path has no CPU rung (the program is welded to the
-    mesh): exhaustion surfaces the checkpoint, and a single-chip resume —
-    the documented degraded path — finishes to the same ranks."""
+def test_pagerank_sharded_exhausted_then_resume(tmp_path, monkeypatch):
+    """With the elastic mesh-shrink rung disabled (GRAFT_ELASTIC=0 — the
+    operator off-switch), the sharded path keeps its pre-elastic
+    contract: exhaustion surfaces the checkpoint, and a single-chip
+    resume finishes to the same ranks.  (With elastic on, device loss is
+    survived in-run instead — tests/test_elastic.py.)"""
     from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
         run_pagerank_sharded,
     )
 
+    monkeypatch.setenv("GRAFT_ELASTIC", "0")
     g = synthetic_powerlaw(600, 2400, seed=11)
     base = run_pagerank(g, PageRankConfig(iterations=9, **GRAPH_KW))
     ckdir = str(tmp_path / "ck")
@@ -373,11 +376,14 @@ def test_tfidf_transient_chunk_failures_are_invisible(tmp_path):
     np.testing.assert_allclose(res.to_dense(), full.to_dense(), atol=1e-6)
 
 
-def test_tfidf_sharded_loss_then_resume(tmp_path):
+def test_tfidf_sharded_loss_then_resume(tmp_path, monkeypatch):
+    """Same off-switch contract for sharded TF-IDF: no shrink rung, so a
+    persistent loss exhausts with a resumable chunk checkpoint."""
     from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
         run_tfidf_sharded,
     )
 
+    monkeypatch.setenv("GRAFT_ELASTIC", "0")
     chunks = _chunks(12)
     base = run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10),
                              n_devices=4)

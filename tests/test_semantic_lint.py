@@ -299,6 +299,26 @@ def test_registry_covers_every_jit_surface():
     assert f"{pkg}/parallel/tfidf_sharded.py" in modules
 
 
+def test_sharded_entries_trace_the_shrink_chain():
+    """Every sharded entry declares one variant per device count on the
+    elastic shrink chain (d, d/2, ..., 1) — the semantic gates must hold
+    for the shrunk meshes a degraded run executes on, down to 1 device."""
+    sharded = [
+        ep for ep in ENTRY_POINTS
+        if ep.name.startswith("pagerank_sharded")
+        or ep.name == "tfidf_sharded_ingest"
+    ]
+    assert len(sharded) == 4
+    for ep in sharded:
+        t = ep.build()
+        labels = [label for label, _ in t.variants]
+        assert len(labels) >= 2, (ep.name, labels)
+        assert any(label.endswith("-d1") or "d1-" in label for label in labels), (
+            ep.name, labels,
+        )
+        assert len(labels) <= ep.max_compiles, (ep.name, labels)
+
+
 def test_repo_semantic_clean():
     """Every registered entry point traces with ZERO findings — the tier-2
     ratchet stays empty (ISSUE 3 acceptance bar)."""

@@ -9,11 +9,16 @@
 # Tests that install their own chaos plan (resilience.chaos.inject) are
 # unaffected: an explicit plan overrides the GRAFT_CHAOS env plan.
 #
+# A second scenario then kills logical device 1 of a forced 2-device CPU
+# mesh (GRAFT_CHAOS="*:device_lost@dev:1") and requires both sharded
+# runners to finish via the elastic mesh-shrink rung with outputs matching
+# an uninterrupted run — the ISSUE 5 acceptance bar.
+#
 # PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
 # can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-exec env -u PALLAS_AXON_POOL_IPS \
+env -u PALLAS_AXON_POOL_IPS \
     JAX_PLATFORMS=cpu \
     GRAFT_CHAOS='*:fail@%5' \
     GRAFT_RETRY_MAX=4 \
@@ -21,3 +26,74 @@ exec env -u PALLAS_AXON_POOL_IPS \
     GRAFT_SYNC_DEADLINE_S=60 \
     python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly "$@"
+
+# ---------------------------------------------------------------------------
+# device_lost sharded scenario (ISSUE 5 acceptance): on a forced 2-device
+# CPU mesh with logical device 1 chaos-killed, BOTH sharded runners must
+# finish via the elastic mesh-shrink rung (no ResilienceExhausted), match
+# the uninterrupted outputs to atol 1e-6 f32, and leave a trace artifact
+# holding exactly ONE mesh.shrink span with devices 2->1.
+echo "== chaos: device_lost sharded scenario (2-device mesh, dev 1 dies) =="
+scenario_dir=$(mktemp -d)
+trap 'rm -rf "$scenario_dir"' EXIT
+env -u PALLAS_AXON_POOL_IPS \
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    GRAFT_TRACE_DIR="$scenario_dir" \
+    SCENARIO_DIR="$scenario_dir" \
+    python - <<'EOF'
+import glob
+import os
+import sys
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.io import synthetic_powerlaw
+from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import run_pagerank
+from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+    run_pagerank_sharded,
+    run_tfidf_sharded,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    PageRankConfig,
+    TfidfConfig,
+)
+
+sys.path.insert(0, "tools")  # chaos.sh runs from the repo root
+import trace_report
+
+kw = dict(dangling="redistribute", init="uniform", dtype="float32")
+g = synthetic_powerlaw(800, 3200, seed=5)
+chunks = [[f"tok{i} tok{i % 5} shared word extra{i % 3}"
+           for i in range(j * 2, (j + 1) * 2)] for j in range(12)]
+
+# uninterrupted references, BEFORE the chaos plan is installed
+base_pr = run_pagerank(g, PageRankConfig(iterations=10, **kw))
+base_tf = run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10),
+                            n_devices=2)
+
+os.environ["GRAFT_CHAOS"] = "*:device_lost@dev:1"
+
+run = obs.start_run("chaos_device_lost", os.environ["SCENARIO_DIR"])
+res = run_pagerank_sharded(g, PageRankConfig(iterations=10, **kw),
+                           n_devices=2)
+np.testing.assert_allclose(res.ranks, base_pr.ranks, atol=1e-6)
+
+elastic.reset_health()  # fresh loss for the second runner
+tf = run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10), n_devices=2)
+np.testing.assert_allclose(tf.to_dense(), base_tf.to_dense(), atol=1e-6)
+obs.end_run()
+
+rep = trace_report.report(glob.glob(
+    os.path.join(os.environ["SCENARIO_DIR"], "chaos_device_lost.*.trace.jsonl")
+)[0])
+shrinks = rep["mesh_shrinks"]
+assert len(shrinks) == 2, shrinks  # one per runner
+for s in shrinks:
+    assert (s["devices_old"], s["devices_new"]) == (2, 1), s
+assert not rep["exhausted"], rep["exhausted"]
+print("device_lost scenario: OK — both sharded runners survived via "
+      f"mesh-shrink ({[s['site'] for s in shrinks]})")
+EOF

@@ -61,7 +61,10 @@ print("traced-run smoke: OK "
       f"wall {rep['wall_secs']:.3f}s)")
 EOF
 
-echo "== chaos gate =="
+echo "== chaos gate (tier-1 under *:fail@%5 + device_lost mesh-shrink scenario) =="
+# chaos.sh's second half runs the device_lost sharded scenario under
+# XLA_FLAGS=--xla_force_host_platform_device_count=2: both sharded runners
+# must survive losing logical device 1 via the elastic mesh-shrink rung.
 tools/chaos.sh
 
 echo "CI: all gates green"

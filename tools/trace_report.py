@@ -164,6 +164,31 @@ def report(path: str) -> dict[str, Any]:
         key=lambda c: c["t_rel"],
     )
 
+    # Elastic mesh-shrink transitions (resilience/elastic.py): one span per
+    # degradation step, carrying old/new device counts and the ladder rung
+    # taken — what makes a degraded bench round attributable from the
+    # artifact alone ("why did throughput halve at +312s?" -> "8->4 shrink").
+    mesh_shrinks = sorted(
+        (
+            {
+                "site": rec["attrs"].get("site"),
+                "ladder": rec["attrs"].get("ladder"),
+                "devices_old": rec["attrs"].get("devices_old"),
+                "devices_new": rec["attrs"].get("devices_new"),
+                "t_rel": rec["t0"] - t0,
+                "secs": rec["secs"],
+                "complete": rec["complete"],
+            }
+            for rec in all_spans
+            if rec["name"] == "mesh.shrink"
+        ),
+        key=lambda s: s["t_rel"],
+    )
+    shrink_sites: dict[str, int] = {}
+    for s in mesh_shrinks:
+        site = str(s["site"] or "?")
+        shrink_sites[site] = shrink_sites.get(site, 0) + 1
+
     last_incomplete = None
     if incomplete:
         deepest = max(incomplete, key=lambda r: r["t0"])
@@ -206,10 +231,44 @@ def report(path: str) -> dict[str, Any]:
         "watchdog": _tally(events, "watchdog"),
         "degraded": _tally(events, "degraded"),
         "exhausted": _tally(events, "exhausted"),
+        "mesh_shrinks": mesh_shrinks,
+        "shrinks": shrink_sites,
         "checkpoints": sum(e["kind"] == "checkpoint_save" for e in events),
         "last_incomplete": last_incomplete,
         "summary": run_end.get("summary") if run_end else None,
     }
+
+
+# Span names that wrap exactly one guarded host sync (a device->host pull
+# or fence).  Their durations are the empirical distribution of healthy
+# sync times — what the adaptive GRAFT_SYNC_DEADLINE_S knob (bench.py) is
+# calibrated against.
+SYNC_SPAN_NAMES = frozenset(
+    {
+        "tfidf.chunk",
+        "tfidf.super_chunk",
+        "tfidf.finalize",
+        "pagerank.ckpt_pull",
+        "pagerank.result_pull",
+    }
+)
+
+
+def sync_p99(path: str, span_names: frozenset = SYNC_SPAN_NAMES) -> float | None:
+    """p99 duration (seconds) over the completed sync-flavored spans in a
+    trace, or None when the trace holds none.  bench.py feeds a PRIOR
+    round's value into the next round's child sync deadline
+    (``max(knob, 3 * p99)``), so the watchdog tracks the tunnel's actually
+    observed behavior instead of a guess."""
+    events, _ = load_events(path)
+    secs = sorted(
+        e.get("secs", 0.0)
+        for e in events
+        if e["kind"] == "span_end" and e.get("name") in span_names
+    )
+    if not secs:
+        return None
+    return secs[min(len(secs) - 1, max(0, -(-99 * len(secs) // 100) - 1))]
 
 
 def render_human(rep: dict[str, Any]) -> str:
@@ -246,10 +305,18 @@ def render_human(rep: dict[str, Any]) -> str:
             lines.append(
                 f"  chunk {c['chunk']}: {c['secs']:.4f}s (at +{c['t_rel']:.2f}s)"
             )
-    for key in ("retries", "chaos", "watchdog", "degraded", "exhausted"):
-        if rep[key]:
+    for key in ("retries", "chaos", "watchdog", "degraded", "exhausted",
+                "shrinks"):
+        if rep.get(key):
             tally = ", ".join(f"{s}={n}" for s, n in sorted(rep[key].items()))
             lines.append(f"{key}: {tally}")
+    for s in rep.get("mesh_shrinks", []):
+        mark = "" if s["complete"] else "  [incomplete]"
+        lines.append(
+            f"mesh shrink: {s['devices_old']}->{s['devices_new']} "
+            f"({s['ladder']}) at +{s['t_rel']:.2f}s, {s['secs']:.3f}s "
+            f"rebuild [{s['site']}]{mark}"
+        )
     if rep["checkpoints"]:
         lines.append(f"checkpoints saved: {rep['checkpoints']}")
     if rep["last_incomplete"]:
