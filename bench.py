@@ -163,13 +163,19 @@ def _measure_impl_traced(impl: str, obs) -> dict:
         cfg = PageRankConfig(iterations=ITERS, dangling="redistribute",
                              init="uniform", dtype="float32", spmv_impl=impl)
         e_dev = jax.device_put(ops.restart_vector(n, cfg))
-        ranks0 = jax.device_put(ops.init_ranks(n, cfg))
+        ranks0_host = ops.init_ranks(n, cfg)
         runner = ops.make_pagerank_runner(n, cfg)
 
     # NOTE: on the axon tunnel block_until_ready() does NOT sync; the only
     # reliable fence is fetching a scalar to host.  Subtract the measured
     # host<->device round-trip so numbers reflect device time.
     def run_once():
+        # the runner donates its rank carry (in-place update on device), so
+        # every rep puts a fresh one — fenced (scalar fetch: the only
+        # reliable sync on the tunnel) BEFORE t0 so the H2D transfer stays
+        # outside the timed region
+        ranks0 = jax.device_put(ranks0_host)
+        float(ranks0[0])
         t0 = time.perf_counter()
         ranks, it, delta = runner(dg, ranks0, e_dev)
         checksum = float(jnp.sum(ranks))
@@ -320,6 +326,58 @@ def _measure_tfidf_traced(obs) -> dict:
             "n_tokens": tok_total, "nnz": out.nnz}
 
 
+def measure_tfidf_sharded() -> dict:
+    """Sharded (multi-device) ingest throughput — the ROADMAP's
+    ``tfidf_sharded_tokens_per_sec``, null in every round before this
+    landed.  Runs the data-parallel super-chunk ingest over a real mesh
+    (simulated CPU devices when no TPU pod is attached: the parent arms
+    ``xla_force_host_platform_device_count`` for this child)."""
+    from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+    with obs.run("tfidf_sharded"):
+        return _measure_tfidf_sharded_traced(obs)
+
+
+def _measure_tfidf_sharded_traced(obs) -> dict:
+    import jax
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
+        DATA_AXIS,
+        make_mesh,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel.tfidf_sharded import (
+        run_tfidf_sharded,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+
+    with obs.span("bench.corpus"):
+        docs = _corpus()
+    d = min(int(os.environ.get("BENCH_TFIDF_SHARDED_DEVICES", "4")),
+            len(jax.devices()))
+    mesh = make_mesh(d, DATA_AXIS)
+    chunk_docs = int(os.environ.get("BENCH_TFIDF_CHUNK_DOCS", "512"))
+    chunks = [docs[i:i + chunk_docs] for i in range(0, len(docs), chunk_docs)]
+    cfg = TfidfConfig(vocab_bits=18, chunk_tokens=1 << 17, prefetch=2)
+
+    def tokens(out) -> int:
+        return int(sum(r["tokens"] for r in out.metrics.records
+                       if r.get("event") == "super_chunk"))
+
+    with obs.span("bench.sharded_warmup"):
+        out = run_tfidf_sharded(iter(chunks), cfg, mesh=mesh)  # compile pass
+    t0 = time.perf_counter()
+    with obs.span("bench.sharded"):
+        out = run_tfidf_sharded(iter(chunks), cfg, mesh=mesh)
+    secs = max(time.perf_counter() - t0, 1e-9)
+    toks = tokens(out)
+    tps = toks / secs
+    log(f"[tfidf-sharded] {len(chunks)} chunks over {d} devices: "
+        f"{secs:.2f}s -> {tps / 1e6:.2f} M tokens/s, nnz={out.nnz}")
+    return {"sharded_tokens_per_sec": tps, "devices": d,
+            "n_tokens": toks, "nnz": out.nnz,
+            "backend": jax.default_backend()}
+
+
 # --------------------------------------------------------------------------
 # parent orchestration (NO jax imports in this section)
 # --------------------------------------------------------------------------
@@ -411,7 +469,7 @@ def _read_ckpt_meta(ck_dir: str) -> dict | None:
 
 
 def _lint_clean() -> bool | None:
-    """Run the graftlint gate (both tiers, CPU-only subprocess) and report
+    """Run the graftlint gate (all three tiers, CPU-only subprocess) and report
     its verdict, so every BENCH_*.json records whether the measured tree
     passed static analysis.  None = the gate itself could not run (never
     blocks the bench)."""
@@ -620,6 +678,7 @@ def _main(graph_cache: str) -> int:
 
     # --- TF-IDF throughput (configs 2 and 5) ---
     tfidf_out = None
+    sharded_out = None
     tfidf_record: dict = {}
     if not os.environ.get("BENCH_SKIP_TFIDF"):
         import shutil
@@ -666,6 +725,18 @@ def _main(graph_cache: str) -> int:
                         ),
                     }
                     log(f"[tfidf] partial record from checkpoint: {tfidf_record}")
+            # Sharded ingest throughput (ROADMAP leftover: the
+            # tfidf_sharded_tokens_per_sec field was null in every round).
+            # On the CPU fallback the child gets simulated devices; on a
+            # live TPU it uses the real pod mesh.
+            sh_env = dict(child_env)
+            if not tpu_alive:
+                flags = sh_env.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    sh_env["XLA_FLAGS"] = (
+                        flags + " --xla_force_host_platform_device_count=4"
+                    ).strip()
+            sharded_out = _run_child("tfidf-sharded", TFIDF_TIMEOUT_S, sh_env)
         finally:
             os.unlink(corpus_cache)
             shutil.rmtree(ck_dir, ignore_errors=True)
@@ -682,6 +753,13 @@ def _main(graph_cache: str) -> int:
                    # or "env" (explicit GRAFT_SYNC_DEADLINE_S)
                    "sync_deadline_s": sync_deadline_s,
                    "sync_deadline_source": sync_deadline_source}
+    # Always present so rounds are comparable: null = the sharded child
+    # did not produce a number this round.
+    extra["tfidf_sharded_tokens_per_sec"] = None
+    if sharded_out and sharded_out.get("sharded_tokens_per_sec"):
+        extra["tfidf_sharded_tokens_per_sec"] = round(
+            sharded_out["sharded_tokens_per_sec"])
+        extra["tfidf_sharded_devices"] = int(sharded_out.get("devices", 0))
     if tfidf_out:
         extra["tfidf_batch_tokens_per_sec"] = round(
             tfidf_out.get("batch_tokens_per_sec", 0.0))
@@ -740,6 +818,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1] == "--tfidf":
         print(json.dumps(measure_tfidf()))
+        sys.exit(0)
+    if len(sys.argv) == 2 and sys.argv[1] == "--tfidf-sharded":
+        print(json.dumps(measure_tfidf_sharded()))
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1].startswith("--impl="):
         print(json.dumps(measure_impl(sys.argv[1].split("=", 1)[1])))

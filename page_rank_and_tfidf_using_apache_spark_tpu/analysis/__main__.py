@@ -3,20 +3,24 @@
 Usage::
 
     python -m page_rank_and_tfidf_using_apache_spark_tpu.analysis \
-        [paths...] [--tier 1|2|all] [--changed-only [BASE]] [--json] \
+        [paths...] [--tier 1|2|3|all] [--changed-only [BASE]] [--json] \
         [--baseline FILE | --no-baseline] [--write-baseline] \
-        [--list-rules] [--list-entry-points]
+        [--cost-report] [--list-rules] [--list-entry-points]
 
 Tier 1 is the lexical AST rule set (stdlib-only; runs even when jax is
 broken).  Tier 2 traces the registered jit entry points on the CPU backend
-and checks jaxpr-level invariants (recompile/promotion/transfer/sharding);
-it needs an importable jax.  Both tiers report through the same ratchet
-baseline.
+and checks jaxpr-level invariants (recompile/promotion/transfer/sharding).
+Tier 3 is the static cost model over the same traces: FLOP/byte
+arithmetic-intensity floors (advisory while xla_cost_tpu.json is not
+TPU-measured), static pad_frac budgets over the partition/padding plans,
+and the buffer-donation verifier against the lowered aliasing.  Tiers 2
+and 3 need an importable jax.  All tiers report through the same ratchet
+baseline; tier-3 advisories are printed but never gate.
 
 With no paths, tier 1 scans the tier-1 surface (the package, ``tools/``
-and ``bench.py``) and tier 2 traces every registered entry point.  With
-explicit paths (or ``--changed-only``), tier 1 scans those files and tier
-2 runs only the entries whose contracted module is among them — unless an
+and ``bench.py``) and tiers 2/3 cover every registered entry point.  With
+explicit paths (or ``--changed-only``), tier 1 scans those files and tiers
+2/3 run only the entries whose contracted module is among them — unless an
 ``analysis/`` file itself changed, which re-verifies every contract.
 
 Exit codes: 0 = no findings beyond the ratchet baseline, 1 = new findings
@@ -51,9 +55,13 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to scan (default: package + tools + bench.py)")
-    ap.add_argument("--tier", choices=("1", "2", "all"), default="all",
+    ap.add_argument("--tier", choices=("1", "2", "3", "all"), default="all",
                     help="1 = lexical rules, 2 = semantic (jaxpr) checks, "
-                         "all = both (default)")
+                         "3 = static cost model (intensity/pad_frac/"
+                         "donation), all = every tier (default)")
+    ap.add_argument("--cost-report", action="store_true",
+                    help="print the tier-3 per-entry cost table as JSON "
+                         "(implies the tier-3 analysis ran)")
     ap.add_argument("--changed-only", nargs="?", const="HEAD", default=None,
                     metavar="BASE",
                     help="lint only files changed vs BASE (default HEAD): "
@@ -74,12 +82,17 @@ def main(argv: list[str] | None = None) -> int:
     if args.list_rules:
         for rule in RULES.values():
             print(f"{rule.id:22s} [tier 1] {rule.summary}")
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis.cost import (
+            COST_RULES,
+        )
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.semantic import (
             SEMANTIC_RULES,
         )
 
         for rid, summary in SEMANTIC_RULES.items():
             print(f"{rid:22s} [tier 2] {summary}")
+        for rid, summary in COST_RULES.items():
+            print(f"{rid:22s} [tier 3] {summary}")
         return 0
 
     if args.list_entry_points:
@@ -99,6 +112,7 @@ def main(argv: list[str] | None = None) -> int:
     root = engine.repo_root()
     tier1 = args.tier in ("1", "all")
     tier2 = args.tier in ("2", "all")
+    tier3 = args.tier in ("3", "all") or args.cost_report
 
     if args.changed_only is not None and args.paths:
         print("graftlint: give either paths or --changed-only, not both",
@@ -139,42 +153,67 @@ def main(argv: list[str] | None = None) -> int:
     findings = engine.run_lint(paths, root) if tier1 else []
 
     scanned = _relpaths(paths, root)
+    advisories: list = []
+    cost_report: dict | None = None
+
+    only_modules = None
+    if restricted:
+        # when the analyzer itself changed, every contract is suspect
+        analyzer_changed = any(
+            p.startswith(
+                "page_rank_and_tfidf_using_apache_spark_tpu/analysis/"
+            )
+            for p in scanned
+        )
+        only_modules = None if analyzer_changed else scanned
+
+    def _tier_unavailable(tier: int, exc: Exception) -> int:
+        # Tier 1 must keep working when jax is broken; tiers 2/3 cannot.
+        # Print what tier 1 found, then fail loudly with a distinct exit
+        # code (2: gate unavailable, vs 1: findings) so callers like
+        # bench.py can tell "dirty" from "could not check".
+        if findings:
+            print(render_human(findings), file=sys.stderr)
+        print(
+            f"graftlint: tier {tier} unavailable "
+            f"({type(exc).__name__}: {exc}); rerun with --tier 1 to "
+            "lint without jax",
+            file=sys.stderr,
+        )
+        return 2
+
     if tier2:
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis import semantic
+
+        try:
+            sem = semantic.run_semantic(root=root, only_modules=only_modules)
+        except Exception as exc:
+            return _tier_unavailable(2, exc)
+        if sem:
+            findings = engine.assign_fingerprints(list(findings) + sem)
+
+    if tier3:
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis import cost
+
+        try:
+            cres = cost.run_cost(root=root, only_modules=only_modules)
+        except Exception as exc:
+            return _tier_unavailable(3, exc)
+        if cres.findings:
+            findings = engine.assign_fingerprints(
+                list(findings) + cres.findings
+            )
+        advisories = cres.advisories
+        cost_report = cres.report
+
+    if tier2 or tier3:
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.registry import (
             ENTRY_POINTS,
         )
 
-        only_modules = None
-        if restricted:
-            # when the analyzer itself changed, every contract is suspect
-            analyzer_changed = any(
-                p.startswith(
-                    "page_rank_and_tfidf_using_apache_spark_tpu/analysis/"
-                )
-                for p in scanned
-            )
-            only_modules = None if analyzer_changed else scanned
-        try:
-            sem = semantic.run_semantic(root=root, only_modules=only_modules)
-        except Exception as exc:
-            # Tier 1 must keep working when jax is broken; tier 2 cannot.
-            # Print what tier 1 found, then fail loudly with a distinct
-            # exit code (2: gate unavailable, vs 1: findings) so callers
-            # like bench.py can tell "dirty" from "could not check".
-            if findings:
-                print(render_human(findings), file=sys.stderr)
-            print(
-                f"graftlint: tier 2 unavailable "
-                f"({type(exc).__name__}: {exc}); rerun with --tier 1 to "
-                "lint without jax",
-                file=sys.stderr,
-            )
-            return 2
-        if sem:
-            findings = engine.assign_fingerprints(list(findings) + sem)
-        # tier-2 findings anchor at their contracted modules: include them
-        # in the written-baseline scan set so --write-baseline is coherent
+        # tier-2/3 findings anchor at their contracted modules: include
+        # them in the written-baseline scan set so --write-baseline is
+        # coherent
         scanned |= {
             ep.module
             for ep in ENTRY_POINTS
@@ -194,21 +233,34 @@ def main(argv: list[str] | None = None) -> int:
 
     baseline = {} if args.no_baseline else engine.load_baseline(bl_path)
     result = engine.apply_ratchet(findings, baseline)
-    # Staleness is only decidable on a full scan with both tiers: a
+    # Staleness is only decidable on a full scan with every tier: a
     # restricted or single-tier run never re-finds entries for files (or
     # rules) it did not look at.
     stale = [] if (restricted or args.tier != "all") else result.stale
 
+    if args.cost_report and cost_report is not None and not args.json:
+        import json as _json
+
+        print(_json.dumps(cost_report, indent=2))
+
     if args.json:
+        extra_json = {}
+        if advisories:
+            extra_json["advisories"] = [f.to_dict() for f in advisories]
+        if args.cost_report and cost_report is not None:
+            extra_json["cost_report"] = cost_report
         print(
             render_json(
                 result.new,
                 known=len(result.known),
                 stale=[e["fingerprint"] for e in stale],
                 ok=result.ok,
+                **extra_json,
             )
         )
     else:
+        for f in advisories:
+            print(f"graftlint: advisory (not gating): {f.render()}")
         if result.new:
             print(render_human(result.new))
             print(

@@ -53,6 +53,12 @@ class Traceable:
     fn: Callable  # callable accepting one variant's args
     variants: Sequence[tuple[str, tuple]]  # (label, args) per matrix point
     anchor: Callable | None = None  # public fn findings point at (else fn)
+    # Tier-3 donation verifier surface: the *raw jitted* callable to
+    # ``.lower()`` (``fn`` may be a partial/dispatch wrapper that hides the
+    # jit boundary and its donate_argnums) plus its static kwargs.  None =
+    # lower ``fn`` itself.
+    donate_fn: Callable | None = None
+    donate_kwargs: dict | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,10 +78,40 @@ class EntryPoint:
     axes: tuple[str, ...] = ()  # declared mesh axes (shard_map entries)
     collective_budget: int | None = None  # comm eqns per step (None = ungated)
     allow_64bit: bool = False  # opt out of the implicit-promotion gate
-    suppress: frozenset = frozenset()  # semantic rule ids to skip
+    suppress: frozenset = frozenset()  # semantic + cost rule ids to skip
+    # ---- tier-3 (analysis/cost.py) budgets ----
+    # Minimum static FLOP/HBM-byte arithmetic intensity per step (worst
+    # variant).  Gating only while xla_cost_tpu.json carries a TPU backend
+    # stamp; advisory otherwise.  None = ungated.
+    intensity_floor: float | None = None
+    # Static padding-waste budget: ``pad_plan()`` returns (label, pad_frac)
+    # plan points evaluated WITHOUT dispatching (plan_partition /
+    # stream_pad_plan); the worst point must stay <= pad_frac_ceiling.
+    pad_plan: Callable[[], Sequence[tuple[str, float]]] | None = None
+    pad_frac_ceiling: float | None = None
+    # Buffer-donation contract: positional argnums of the traceable's
+    # donate_fn whose buffers the lowered computation must alias to an
+    # output.  None = unchecked; () = must alias nothing.
+    donate: tuple[int, ...] | None = None
 
 
 _PKG = "page_rank_and_tfidf_using_apache_spark_tpu"
+
+# ``--tier all`` runs two analyzers (semantic + cost) over the same
+# registry in one process; building an entry — graph synthesis, mesh
+# construction, partitioning per shrink-chain device count — is the
+# expensive part of a lint pass, and the Traceable is immutable, so build
+# once per process.  (Each tier still traces under its own config context:
+# tier 2 under x64, tier 3 under production dtypes.)  Failures are NOT
+# cached: a broken entry must re-raise in every tier that looks at it.
+_BUILD_CACHE: "dict[EntryPoint, Traceable]" = {}
+
+
+def build_traceable(ep: "EntryPoint") -> "Traceable":
+    t = _BUILD_CACHE.get(ep)
+    if t is None:
+        t = _BUILD_CACHE[ep] = ep.build()
+    return t
 
 
 def _sds(shape, dtype):
@@ -203,6 +239,44 @@ def _sharded_pagerank_traceable(strategy: str) -> Traceable:
     )
 
 
+def _sharded_pad_plan(strategy: str):
+    """Static padding-waste plan points for a sharded entry: pad_frac of
+    the partition *plan* (parallel.pagerank_sharded.plan_partition — no
+    arrays materialized, no dispatch) on the registry's trace graph, one
+    point per device count on the elastic shrink chain."""
+
+    def plan() -> list[tuple[str, float]]:
+        import jax
+
+        from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
+            synthetic_powerlaw,
+        )
+        from page_rank_and_tfidf_using_apache_spark_tpu.parallel.pagerank_sharded import (
+            plan_partition,
+        )
+
+        graph = synthetic_powerlaw(64, 256, seed=1)
+        return [
+            (
+                f"{strategy}-d{d}",
+                plan_partition(graph, d, strategy=strategy).pad_frac,
+            )
+            for d in _shrink_chain(min(4, len(jax.devices())))
+        ]
+
+    return plan
+
+
+def _chunk_pad_plan() -> "list[tuple[str, float]]":
+    """Static padding waste of the streaming ingest's grow_chunk_cap
+    policy over the declared raw-token matrix."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+        stream_pad_plan,
+    )
+
+    return stream_pad_plan(CHUNK_TOKEN_MATRIX)
+
+
 def _build_pagerank_sharded_edges() -> Traceable:
     return _sharded_pagerank_traceable("edges")
 
@@ -278,6 +352,80 @@ def _build_tfidf_chunk_drain() -> Traceable:
         )
     fn = functools.partial(ops.chunk_counts, vocab=1 << 10)
     return Traceable(fn=fn, variants=variants, anchor=ops.chunk_counts)
+
+
+def _build_pagerank_pallas() -> Traceable:
+    """The spmv_impl='pallas' fixpoint runner, traced in interpret mode.
+
+    Mosaic only compiles on real TPUs, but ``_spmv`` flips the kernel to
+    the Pallas *interpreter* whenever the trace-time backend is not TPU —
+    so on the analyzer's pinned CPU backend the full runner (gather +
+    pallas_call prefix sum + CSR diff + damping epilogue) traces into one
+    jaxpr and every tier-2/tier-3 gate (promotion, transfer census,
+    intensity, donation) covers the Pallas path too, chip or no chip."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import (
+        pallas_kernels as pk,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
+
+    n, e = 64, 256
+    cfg = PageRankConfig(iterations=4, dangling="redistribute",
+                         init="uniform", spmv_impl="pallas")
+    run = ops.make_pagerank_runner(n, cfg)
+    dg = _device_graph_spec(n, e)
+    return Traceable(
+        fn=run,
+        variants=[("n64-pallas", (dg, _f32((n,)), _f32((n,))))],
+        anchor=pk.spmv_pallas,
+    )
+
+
+def _build_tfidf_chunk_ingest_carry() -> Traceable:
+    """The production streaming kernel: chunk counts + the device-resident
+    donated DF carry (ops.chunk_counts_carry), shape matrix through the
+    real grow_chunk_cap policy exactly like the legacy drain entry."""
+    import functools
+    import logging
+
+    import numpy as np
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import grow_chunk_cap
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import (
+        MetricsRecorder,
+    )
+
+    vocab = 1 << 10
+    log = logging.getLogger("pr_tfidf_tpu")
+    was_disabled = log.disabled
+    log.disabled = True
+    try:
+        metrics = MetricsRecorder()
+        cap = 0
+        caps: list[int] = []
+        for raw in CHUNK_TOKEN_MATRIX:
+            cap, _ = grow_chunk_cap(raw, cap, metrics)
+            caps.append(cap)
+    finally:
+        log.disabled = was_disabled
+    variants = []
+    for raw, cap in zip(CHUNK_TOKEN_MATRIX, caps):
+        variants.append(
+            (
+                f"tokens{raw}",
+                (_i32((cap,)), _i32((cap,)), _sds((cap,), np.bool_),
+                 _f32((vocab,))),
+            )
+        )
+    fn = functools.partial(ops.chunk_counts_carry, vocab=vocab)
+    return Traceable(
+        fn=fn,
+        variants=variants,
+        anchor=ops.chunk_counts_carry,
+        donate_fn=ops.chunk_counts_carry,
+        donate_kwargs={"vocab": vocab},
+    )
 
 
 def _build_tfidf_sharded_ingest() -> Traceable:
@@ -364,11 +512,27 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         name="pagerank_step",
         module=f"{_PKG}/ops/pagerank.py",
         build=_build_pagerank_scan,
+        # iterate-to-fixpoint runner: the rank carry (argnum 1 of
+        # run(dg, ranks0, e)) is donated — verified against the lowered
+        # aliasing by the tier-3 donation check
+        donate=(1,),
+        intensity_floor=0.05,  # static model measures 0.066
     ),
     EntryPoint(
         name="pagerank_step_tol_cumsum",
         module=f"{_PKG}/ops/pagerank.py",
         build=_build_pagerank_while_cumsum,
+        donate=(1,),
+        intensity_floor=0.045,  # static model measures 0.054
+    ),
+    EntryPoint(
+        name="pagerank_step_pallas",
+        module=f"{_PKG}/ops/pallas_kernels.py",
+        build=_build_pagerank_pallas,
+        # the runner composes ops/pagerank.py machinery around the kernel
+        watch=(f"{_PKG}/ops/pagerank.py",),
+        donate=(1,),
+        intensity_floor=0.04,  # static model measures 0.050
     ),
     EntryPoint(
         name="pagerank_sharded_edges",
@@ -386,6 +550,10 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         collective_budget=1,
         # one compile per device count on the elastic shrink chain (4,2,1)
         max_compiles=3,
+        # equal contiguous edge slices: padding is only the ceil remainder
+        pad_plan=_sharded_pad_plan("edges"),
+        pad_frac_ceiling=0.05,
+        intensity_floor=0.035,  # static model: 0.047 at d=1 (worst)
     ),
     EntryPoint(
         name="pagerank_sharded_nodes_balanced",
@@ -402,6 +570,14 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         collective_budget=3,
         # one compile per device count on the elastic shrink chain (4,2,1)
         max_compiles=3,
+        # Power-law in-degree concentrates edges: the capped equal-edge
+        # split still pads heavily on hub-dense tiny graphs (0.47 at d=4 on
+        # the trace graph; the 8-device dryrun measures 0.61).  This
+        # ceiling is the RATCHET SURFACE for the ROADMAP "pad_frac below
+        # 0.25" goal — tighten it as the hybrid partitioning work lands.
+        pad_plan=_sharded_pad_plan("nodes_balanced"),
+        pad_frac_ceiling=0.50,
+        intensity_floor=0.035,  # static model: 0.045 at d=4 (worst)
     ),
     EntryPoint(
         name="pagerank_sharded_src",
@@ -418,11 +594,16 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         collective_budget=3,
         # one compile per device count on the elastic shrink chain (4,2,1)
         max_compiles=3,
+        # push layout: out-degree is the bounded axis, padding stays small
+        pad_plan=_sharded_pad_plan("src"),
+        pad_frac_ceiling=0.25,
+        intensity_floor=0.03,  # static model: 0.040 at d=4 (worst)
     ),
     EntryPoint(
         name="tfidf_batch_pipeline",
         module=f"{_PKG}/ops/tfidf.py",
         build=_build_tfidf_batch,
+        intensity_floor=0.09,  # static model measures 0.109
     ),
     EntryPoint(
         name="tfidf_chunk_drain",
@@ -434,6 +615,25 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         # The doubling cap policy may legally produce a handful of buckets
         # over a whole stream; the declared matrix must collapse to <= 3.
         max_compiles=3,
+        # stream-aggregate padding of the doubling-cap policy (~0.13 on
+        # the declared matrix; doubling bounds the worst steady state at
+        # <0.5 but the declared workload must stay far under that)
+        pad_plan=_chunk_pad_plan,
+        pad_frac_ceiling=0.20,
+        intensity_floor=0.25,  # static model: 0.265 at the smallest cap
+    ),
+    EntryPoint(
+        name="tfidf_chunk_ingest_carry",
+        module=f"{_PKG}/ops/tfidf.py",
+        build=_build_tfidf_chunk_ingest_carry,
+        watch=(f"{_PKG}/models/tfidf.py",),
+        max_compiles=3,
+        pad_plan=_chunk_pad_plan,
+        pad_frac_ceiling=0.20,
+        # the ingest carry: the device DF accumulator (argnum 3) must be
+        # donated so XLA updates it in place every chunk
+        donate=(3,),
+        intensity_floor=0.25,  # static model: 0.265 at the smallest cap
     ),
     EntryPoint(
         name="tfidf_sharded_ingest",
@@ -450,15 +650,18 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         collective_budget=1,
         # one compile per device count on the elastic shrink chain (4,2,1)
         max_compiles=3,
+        intensity_floor=0.15,  # static model measures 0.180
     ),
     EntryPoint(
         name="tfidf_finalize",
         module=f"{_PKG}/ops/tfidf.py",
         build=_build_tfidf_finalize,
+        intensity_floor=0.045,  # static model measures 0.061
     ),
     EntryPoint(
         name="tfidf_score_query",
         module=f"{_PKG}/ops/tfidf.py",
         build=_build_tfidf_score_query,
+        intensity_floor=0.04,  # static model measures 0.060
     ),
 )

@@ -50,6 +50,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.analysis.registry import (
     ENTRY_POINTS,
     EntryPoint,
     Traceable,
+    build_traceable,
 )
 
 SEMANTIC_RULES: dict[str, str] = {
@@ -229,7 +230,7 @@ def _analyze_entry(ep: EntryPoint, root: Path) -> list[Finding]:
         )
 
     try:
-        t = ep.build()
+        t = build_traceable(ep)
     except Exception as exc:  # registry drifted from the code
         add(
             "entry-point-broken",

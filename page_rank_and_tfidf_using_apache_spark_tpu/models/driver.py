@@ -120,7 +120,18 @@ def run_segments(
     failures retry with backoff (the runner is functional, so re-invoking
     with the same ranks cannot double-apply iterations), persistent ones
     walk the rungs above, and exhaustion raises ``ResilienceExhausted``
-    carrying the latest checkpoint under ``cfg.checkpoint_dir``.
+    carrying the latest checkpoint under ``cfg.checkpoint_dir``.  The
+    single-chip runners *donate* their rank carry (ops/pagerank.py), so
+    ``invoke`` must never let a post-dispatch sync failure reach this
+    site's retry (which would re-dispatch into the consumed buffer):
+    models/pagerank.py fetches the delta through its own guarded site
+    (``pagerank_delta_sync``) whose retries re-pull against live OUTPUT
+    buffers, and an exhausted inner fetch is non-transient here — it
+    walks the rungs, and a rung that cannot read the consumed carry
+    raises onward until ``ResilienceExhausted`` hands the caller the
+    latest checkpoint.  This site's own transient failures (chaos fires
+    at attempt start, before dispatch) still retry with the carry
+    intact.
 
     Checkpoints are tagged with the segment's ``extra_metrics`` (the
     sharded runners put ``devices=N`` there), so a snapshot records which

@@ -64,8 +64,18 @@ def run_pagerank(
     make = ops.make_spark_exact_runner if cfg.spark_exact else ops.make_pagerank_runner
 
     def invoke(runner, rd):
+        # Async dispatch consumes (donates) the rank carry ``rd`` — so the
+        # scalar sync below must NOT surface transient failures to the
+        # outer pagerank_step guard, whose retry would re-dispatch into
+        # the consumed buffer.  The fetch gets its own guarded site: a
+        # tunnel blip re-pulls the scalar against the still-live OUTPUT
+        # buffers, which is always safe.
         rd, iters, delta = runner(dg, rd, e)
-        delta = float(delta)  # scalar fetch is the only reliable device sync
+        with obs.span("pagerank.delta_sync"):
+            delta = float(rx.device_get(
+                delta, site="pagerank_delta_sync", metrics=metrics,
+                checkpoint_dir=cfg.checkpoint_dir,
+            ))  # scalar fetch is the only reliable device sync
         return rd, iters, delta
 
     def make_cpu_invoke(seg_cfg):

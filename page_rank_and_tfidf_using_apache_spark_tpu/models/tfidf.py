@@ -130,6 +130,35 @@ def grow_chunk_cap(
     return cap, changed
 
 
+def stream_pad_plan(
+    raw_token_counts: Sequence[int], cap: int = 0
+) -> list[tuple[str, float]]:
+    """Static padding-waste plan of the streaming ingest: run the raw
+    per-chunk token counts through the REAL :func:`grow_chunk_cap` policy
+    (no dispatch, no device) and return ``[("stream", pad_frac)]`` where
+    ``pad_frac`` is the fraction of dispatched token slots that are padding
+    across the whole stream.  This is the tier-3 pad_frac surface for the
+    chunk-ingest entry points (analysis/cost.py), the TF-IDF counterpart of
+    ``parallel.pagerank_sharded.plan_partition``."""
+    import logging
+
+    log = logging.getLogger("pr_tfidf_tpu")
+    was_disabled = log.disabled
+    log.disabled = True  # cap-bump log lines are production telemetry
+    try:
+        metrics = MetricsRecorder()
+        total_raw = 0
+        total_cap = 0
+        for raw in raw_token_counts:
+            cap, _ = grow_chunk_cap(raw, cap, metrics)
+            total_raw += int(raw)
+            total_cap += cap
+    finally:
+        log.disabled = was_disabled
+    pad_frac = (total_cap - total_raw) / max(total_cap, 1)
+    return [("stream", pad_frac)]
+
+
 @dataclasses.dataclass
 class IngestState:
     """Accumulated streaming-ingest state, shared by the streaming and
@@ -415,6 +444,15 @@ def run_tfidf_streaming(
     caller's iterator runs on the calling thread) and every chunk syncs
     before the next launches.  Results are bit-identical at every depth —
     only scheduling changes.
+
+    The DF accumulator is an **ingest carry**: a device-resident vector
+    threaded through :func:`ops.tfidf.chunk_counts_carry` with its buffer
+    donated, so XLA updates it in place every chunk and the host never
+    pulls DF per chunk.  DF reaches the host only at *commit points* —
+    checkpoint saves and finalize — which also means a checkpoint can only
+    be written once every in-flight launch has drained (a snapshot must
+    never contain DF contributions from chunks it does not record as
+    ingested).
     """
     ensure_dtype_support(cfg.dtype)
     metrics = metrics or MetricsRecorder()
@@ -426,18 +464,22 @@ def run_tfidf_streaming(
           else IngestState(df_total=np.zeros(vocab, dtype)))
     secs0 = st.ingest_secs
     run_started = time.perf_counter()
+    last_ckpt = st.chunk_index
+    # The device-resident DF carry (donated to every chunk dispatch; this
+    # reference is always the LATEST carry, never a consumed one).
+    df_dev = jnp.asarray(st.df_total)
 
     depth = max(int(cfg.prefetch), 0)
     source = _tokenized_chunks(doc_chunks, cfg, st.chunk_index, st.n_docs)
     if depth > 0:
         source = _prefetched(source, depth)
 
-    # In-flight launched chunks: (i, counts, df_inc, doc_lengths, n_chunk_docs,
+    # In-flight launched chunks: (i, counts, doc_lengths, n_chunk_docs,
     # n_tokens, launch Timer).
     inflight: collections.deque = collections.deque()
 
     def drain_one():
-        i, counts, df_inc, doc_lengths, n_chunk_docs, n_tokens, t = inflight.popleft()
+        i, counts, doc_lengths, n_chunk_docs, n_tokens, t = inflight.popleft()
         with Timer() as t_sync, obs.span("tfidf.chunk", chunk=i):
             # Wait for this chunk's device results with ONE batched
             # device->host pull.  The old path paid five round-trips per
@@ -445,13 +487,15 @@ def run_tfidf_streaming(
             # the df pull) — at ~76 ms tunnel RTT that serialized the
             # whole streaming path (VERDICT.md round 5).  Pulling the
             # padded arrays whole costs a few MB of extra bytes but only
-            # one round-trip; the slice happens on host.  The pull runs
-            # under the resilience executor: a transient failure or blown
-            # sync deadline re-issues the transfer (device buffers are
-            # still live); exhaustion surfaces ResilienceExhausted carrying
-            # the last chunk checkpoint to resume from.
-            h_doc, h_term, h_count, h_n_pairs, h_df = rx.device_get(
-                (counts.doc, counts.term, counts.count, counts.n_pairs, df_inc),
+            # one round-trip; the slice happens on host.  (The DF vector is
+            # no longer part of this pull at all — it stays on device as
+            # the donated ingest carry until a commit point.)  The pull
+            # runs under the resilience executor: a transient failure or
+            # blown sync deadline re-issues the transfer (device buffers
+            # are still live); exhaustion surfaces ResilienceExhausted
+            # carrying the last chunk checkpoint to resume from.
+            h_doc, h_term, h_count, h_n_pairs = rx.device_get(
+                (counts.doc, counts.term, counts.count, counts.n_pairs),
                 site="tfidf_chunk_sync", metrics=metrics,
                 checkpoint_dir=cfg.checkpoint_dir,
             )
@@ -460,7 +504,6 @@ def run_tfidf_streaming(
             # whole cap-sized transfer buffer until finalize
             st.parts.append((h_doc[:k].copy(), h_term[:k].copy(), h_count[:k].copy()))
         st.doc_length_parts.append(doc_lengths)
-        st.df_total = st.df_total + h_df.astype(dtype)
         st.n_docs += n_chunk_docs
         st.n_tokens += n_tokens
         st.chunk_index = i + 1
@@ -469,25 +512,51 @@ def run_tfidf_streaming(
                        secs=t_sync.elapsed)
         obs.counter("tfidf.chunks")
         obs.histogram("tfidf.chunk_secs", t_sync.elapsed)
-        if (cfg.checkpoint_every > 0 and cfg.checkpoint_dir
-                and st.chunk_index % cfg.checkpoint_every == 0):
-            st.ingest_secs = secs0 + (time.perf_counter() - run_started)
-            save_ingest_checkpoint(cfg, metrics, st)
+
+    def commit_df():
+        # Pull the device DF carry into host state.  Only legal when no
+        # launch is in flight: the carry always reflects every DISPATCHED
+        # chunk, so a mid-flight pull would commit DF for chunks the state
+        # does not count as ingested.  Its own site (not tfidf_chunk_sync):
+        # chaos schedules and retry tallies count per-chunk drains, and a
+        # commit is not a chunk.
+        assert not inflight, "DF commit with launches in flight"
+        with obs.span("tfidf.df_commit"):
+            st.df_total = rx.device_get(
+                df_dev, site="tfidf_df_commit", metrics=metrics,
+                checkpoint_dir=cfg.checkpoint_dir,
+            ).astype(dtype)
+
+    def maybe_checkpoint():
+        nonlocal last_ckpt
+        if not (cfg.checkpoint_every > 0 and cfg.checkpoint_dir):
+            return
+        if st.chunk_index - last_ckpt < cfg.checkpoint_every:
+            return
+        while inflight:  # drain to the commit point (see commit_df)
+            drain_one()
+        commit_df()
+        st.ingest_secs = secs0 + (time.perf_counter() - run_started)
+        save_ingest_checkpoint(cfg, metrics, st)
+        last_ckpt = st.chunk_index
 
     with obs.span("tfidf.stream", resume_chunk=st.chunk_index):
         for i, corpus in source:
             cap, _ = grow_chunk_cap(corpus.n_tokens, cap, metrics, chunk=i)
             doc_ids, term_ids, valid = _pad_chunk(corpus, cap)
             with Timer() as t:
-                counts, df_inc = ops.chunk_counts(
-                    jnp.asarray(doc_ids), jnp.asarray(term_ids), jnp.asarray(valid),
-                    vocab=vocab,
-                )  # async dispatch — no block here
-            inflight.append((i, counts, df_inc, corpus.doc_lengths,
+                counts, df_dev = ops.chunk_counts_carry(
+                    jnp.asarray(doc_ids), jnp.asarray(term_ids),
+                    jnp.asarray(valid), df_dev, vocab=vocab,
+                )  # async dispatch — no block here; df carry updated in place
+            inflight.append((i, counts, corpus.doc_lengths,
                              corpus.n_docs, corpus.n_tokens, t))
             while len(inflight) > depth:
                 drain_one()
+            maybe_checkpoint()
         while inflight:
             drain_one()
+            maybe_checkpoint()
+        commit_df()
 
     return finalize_tfidf(st, cfg, metrics)

@@ -28,6 +28,7 @@ Semantics flags (SURVEY.md §3.1 dangling-node caveat):
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
 import jax
@@ -260,6 +261,15 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig):
     The Python-side driver loop of the reference (SURVEY.md §3.1 🔥 outer
     loop) disappears entirely — there are no host round-trips between
     iterations.
+
+    ``ranks0`` is **donated** (``donate_argnums=(1,)``): the carry is dead
+    the moment the loop starts, so XLA reuses its buffer for the output
+    ranks instead of holding two node-sized vectors live across the whole
+    loop.  The input array is consumed — callers that re-invoke a runner
+    must re-``device_put`` a fresh carry (the segment driver threads each
+    segment's output into the next, so it never reuses one; bench.py re-puts
+    per timing rep).  The tier-3 donation verifier (analysis/cost.py) holds
+    this contract against the lowered computation's input/output aliasing.
     """
     damping = cfg.damping
     impl = cfg.spmv_impl
@@ -275,7 +285,7 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig):
 
     if cfg.tol > 0.0:
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(1,))
         def run(dg: DeviceGraph, ranks0: jax.Array, e: jax.Array):
             def cond(carry):
                 _, delta, it = carry
@@ -292,7 +302,7 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig):
 
         return run
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1,))
     def run(dg: DeviceGraph, ranks0: jax.Array, e: jax.Array):
         def body(ranks, _):
             new = step_fn(ranks, dg, e)
@@ -307,9 +317,10 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig):
 
 def make_spark_exact_runner(n: int, cfg: PageRankConfig):
     """Runner for spark_exact mode (always fixed iterations, like the
-    reference's ``for i in range(iters)`` driver loop)."""
+    reference's ``for i in range(iters)`` driver loop).  ``ranks0`` is
+    donated, same contract as :func:`make_pagerank_runner`."""
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1,))
     def run(dg: DeviceGraph, ranks0: jax.Array, e: jax.Array):
         del e  # spark_exact is never personalized
         state0 = SparkExactState(ranks=ranks0, present=dg.has_outlinks)

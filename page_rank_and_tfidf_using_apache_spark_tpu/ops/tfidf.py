@@ -229,6 +229,34 @@ def chunk_counts(
     return counts, df
 
 
+@functools.partial(
+    jax.jit, static_argnames=("vocab",), donate_argnums=(3,))
+def chunk_counts_carry(
+    doc_ids: jax.Array,
+    term_ids: jax.Array,
+    token_valid: jax.Array,
+    df_carry: jax.Array,
+    *,
+    vocab: int,
+) -> tuple[SparseCounts, jax.Array]:
+    """The production streaming-ingest kernel: one fixed-shape chunk →
+    (per-pair counts, **updated device-resident DF accumulator**).
+
+    Unlike :func:`chunk_counts` (which returns a per-chunk DF *increment*
+    for the host to add up), the DF vector lives on device across the whole
+    stream and ``df_carry`` is **donated**: XLA writes the accumulated DF
+    back into the same buffer every chunk instead of allocating a fresh
+    vocab-sized vector, and the host never pulls DF per chunk — only at
+    checkpoint commit points and finalize (models/tfidf.py).  At vocab 2^18
+    that removes a ~1 MB device→host transfer per chunk from the streaming
+    hot loop.  The tier-3 donation verifier (analysis/cost.py) holds the
+    donation against the lowered computation's input/output aliasing.
+    """
+    counts = count_pairs(doc_ids, term_ids, token_valid=token_valid)
+    df = document_frequency(counts, vocab)
+    return counts, df_carry + df
+
+
 @functools.partial(jax.jit, static_argnames=("n_docs", "k"))
 def score_query(
     result: TfidfResult,

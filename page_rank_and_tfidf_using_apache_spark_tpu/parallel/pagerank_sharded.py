@@ -123,6 +123,86 @@ def auto_select_strategy(
     return "edges"
 
 
+class PartitionPlan(NamedTuple):
+    """Pure *planning* output of a shard strategy: split boundaries, padded
+    sizes and the padding-waste fraction, computed without materializing a
+    single per-device array (and without any device dispatch).
+
+    This is the introspection surface the graftlint tier-3 pad_frac
+    analyzer gates on (``analysis/cost.py``): ``partition_graph`` builds its
+    arrays FROM this plan, so the static number the linter budgets is — by
+    construction, not by convention — the same ``pad_frac`` a real
+    multichip run logs in its ``partition`` event (cross-checked against
+    MULTICHIP_r05.json by tests/test_cost_lint.py)."""
+
+    strategy: str
+    n: int  # real node count
+    n_pad: int  # D * block
+    block: int  # nodes per device block
+    e_dev: int  # edge slots per device (padded width)
+    pad_frac: float  # fraction of padded edge slots (load-imbalance gauge)
+    bounds_nodes: np.ndarray | None = None  # [D+1] node-block boundaries
+    ebounds: np.ndarray | None = None  # [D+1] edge-range boundaries (nodes*)
+    per: np.ndarray | None = None  # [D] real edges per device ('src*')
+
+
+def plan_partition(
+    graph: Graph, n_devices: int, *, strategy: str = "edges"
+) -> PartitionPlan:
+    """Plan a partition without building it: boundaries, padded widths and
+    ``pad_frac`` only — O(E) host work, no per-device arrays, no device
+    traffic.  ``partition_graph`` materializes exactly this plan."""
+    if strategy not in ("edges", "nodes", "nodes_balanced", "src", "src_ring"):
+        raise ValueError(f"unknown shard strategy {strategy!r}")
+    d = n_devices
+    n = graph.n_nodes
+    e = graph.n_edges
+
+    if strategy in ("src", "src_ring"):
+        block = max(1, math.ceil(n / d))
+        n_pad = block * d
+        per = np.bincount(graph.src // block, minlength=d)
+        e_dev = max(1, int(per.max()))
+        pad_frac = (d * e_dev - e) / max(d * e_dev, 1)
+        return PartitionPlan(strategy, n, n_pad, block, e_dev, pad_frac,
+                             per=per)
+
+    if strategy == "edges":
+        block = max(1, math.ceil(n / d))
+        e_dev = max(1, math.ceil(e / d))
+        cap = e_dev * d
+        pad_frac = (cap - e) / max(cap, 1)
+        return PartitionPlan(strategy, n, block * d, block, e_dev, pad_frac)
+
+    if strategy == "nodes":
+        block = max(1, math.ceil(n / d))
+        bounds_nodes = np.minimum(np.arange(0, d + 1) * block, n)
+    else:  # nodes_balanced
+        # Equal-edge boundaries, but with per-device node count capped at
+        # 2x the equal-node block: the uniform padded block is the max
+        # device's node count, so an uncapped edge-balanced split of a
+        # hub-heavy graph (hubs first, a huge low-degree tail on the last
+        # device) would push n_pad toward n*d and forfeit the 1/D memory
+        # scaling this layout exists for.  The cap bounds memory at 2x the
+        # 'nodes' layout while keeping edges near-balanced whenever the
+        # degree distribution allows.
+        cap = 2 * max(1, math.ceil(n / d))
+        indptr = graph.csr_indptr()
+        bounds_nodes = np.zeros(d + 1, np.int64)
+        for i in range(1, d):
+            target = int(np.searchsorted(indptr, (i * e) // d, side="left"))
+            lo = max(bounds_nodes[i - 1], n - (d - i) * cap)  # leave capacity
+            hi = min(bounds_nodes[i - 1] + cap, n)
+            bounds_nodes[i] = min(max(target, lo), hi)
+        bounds_nodes[d] = n
+        block = max(1, int(np.diff(bounds_nodes).max()))
+    ebounds = np.searchsorted(graph.dst, bounds_nodes)
+    e_dev = max(1, int(np.diff(ebounds).max()))
+    pad_frac = (d * e_dev - e) / max(d * e_dev, 1)
+    return PartitionPlan(strategy, n, block * d, block, e_dev, pad_frac,
+                         bounds_nodes=bounds_nodes, ebounds=ebounds)
+
+
 class ShardedGraph(NamedTuple):
     """Host-side partitioned graph layout, ready for device_put.
 
@@ -163,12 +243,18 @@ def partition_graph(
     ``need_local_indptr=False`` skips the per-device CSR pointer build —
     only spmv_impl='cumsum' reads it, and under 'edges' it costs D
     node-sized int32 arrays (a (D, 1) placeholder is stored instead so the
-    runner signature stays fixed)."""
-    if strategy not in ("edges", "nodes", "nodes_balanced", "src", "src_ring"):
-        raise ValueError(f"unknown shard strategy {strategy!r}")
+    runner signature stays fixed).
+
+    All split boundaries, padded widths and ``pad_frac`` come from
+    :func:`plan_partition` — the static plan the tier-3 cost linter
+    budgets is the one this function materializes."""
+    plan = plan_partition(graph, n_devices, strategy=strategy)
     d = n_devices
     n = graph.n_nodes
     e = graph.n_edges
+    block, n_pad, e_dev, pad_frac = (
+        plan.block, plan.n_pad, plan.e_dev, plan.pad_frac
+    )
 
     inv_g = np.where(
         graph.out_degree > 0, 1.0 / np.maximum(graph.out_degree, 1), 0.0
@@ -185,14 +271,11 @@ def partition_graph(
         # both combines and re-shards it.  Hub-heavy *in*-degree (the
         # power-law axis of web graphs) cannot imbalance this layout: edges
         # follow their source, and out-degree is the bounded one.
-        block = max(1, math.ceil(n / d))
-        n_pad = block * d
         owner = graph.src // block
         order = np.lexsort((graph.dst, owner))  # by device, then dst-sorted
         src_o = graph.src[order]
         dst_o = graph.dst[order]
-        per = np.bincount(owner, minlength=d)
-        e_dev = max(1, int(per.max()))
+        per = plan.per
         starts = np.concatenate([[0], np.cumsum(per)])
         src_l = np.zeros((d, e_dev), np.int32)
         dst2 = np.full((d, e_dev), n_pad - 1, np.int32)  # pad keeps dst sorted
@@ -203,7 +286,6 @@ def partition_graph(
             src_l[i, :k] = src_o[lo:hi] - i * block  # block-local sources
             dst2[i, :k] = dst_o[lo:hi]
             valid[i, :k] = 1.0
-        pad_frac = (d * e_dev - e) / max(d * e_dev, 1)
         inv = np.zeros(n_pad, dtype)
         inv[:n] = inv_g
         dangling = np.zeros(n_pad, dtype)
@@ -225,9 +307,6 @@ def partition_graph(
                             np.arange(n, dtype=np.int64), local_indptr)
 
     if strategy == "edges":
-        block = max(1, math.ceil(n / d))
-        n_pad = block * d
-        e_dev = max(1, math.ceil(e / d))
         cap = e_dev * d
         src = np.full(cap, 0, np.int32)
         dst = np.full(cap, n_pad - 1, np.int32)  # keeps dst sorted per slice tail
@@ -235,7 +314,6 @@ def partition_graph(
         src[:e] = graph.src
         dst[:e] = graph.dst
         valid[:e] = 1.0
-        pad_frac = (cap - e) / max(cap, 1)
         inv = np.zeros(n_pad, dtype)
         inv[:n] = inv_g
         dangling = np.zeros(n_pad, dtype)
@@ -264,30 +342,9 @@ def partition_graph(
     # dst-sorted edge array).  'nodes' picks equal-node boundaries; padding
     # each device's edge slice to the max then bears the full power-law
     # imbalance.  'nodes_balanced' picks boundaries at equal-EDGE splits
-    # (node-granular), evening out SpMV work instead.
-    if strategy == "nodes":
-        block = max(1, math.ceil(n / d))
-        bounds_nodes = np.minimum(np.arange(0, d + 1) * block, n)
-    else:
-        # Equal-edge boundaries, but with per-device node count capped at
-        # 2x the equal-node block: the uniform padded block is the max
-        # device's node count, so an uncapped edge-balanced split of a
-        # hub-heavy graph (hubs first, a huge low-degree tail on the last
-        # device) would push n_pad toward n*d and forfeit the 1/D memory
-        # scaling this layout exists for.  The cap bounds memory at 2x the
-        # 'nodes' layout while keeping edges near-balanced whenever the
-        # degree distribution allows.
-        cap = 2 * max(1, math.ceil(n / d))
-        indptr = graph.csr_indptr()
-        bounds_nodes = np.zeros(d + 1, np.int64)
-        for i in range(1, d):
-            target = int(np.searchsorted(indptr, (i * e) // d, side="left"))
-            lo = max(bounds_nodes[i - 1], n - (d - i) * cap)  # leave capacity
-            hi = min(bounds_nodes[i - 1] + cap, n)
-            bounds_nodes[i] = min(max(target, lo), hi)
-        bounds_nodes[d] = n
-        block = max(1, int(np.diff(bounds_nodes).max()))
-    n_pad = block * d
+    # (node-granular, capped at 2x the equal-node block — see
+    # plan_partition), evening out SpMV work instead.
+    bounds_nodes = plan.bounds_nodes
 
     # global node id → padded slot (device i's nodes at [i*block, ...))
     node_map = np.empty(n, np.int64)
@@ -295,9 +352,7 @@ def partition_graph(
         lo, hi = bounds_nodes[i], bounds_nodes[i + 1]
         node_map[lo:hi] = i * block + np.arange(hi - lo)
 
-    ebounds = np.searchsorted(graph.dst, bounds_nodes)
-    per = np.diff(ebounds)
-    e_dev = max(1, int(per.max()))
+    ebounds = plan.ebounds
     src = np.zeros((d, e_dev), np.int32)
     dst_local = np.full((d, e_dev), block - 1, np.int32)
     valid = np.zeros((d, e_dev), dtype)
@@ -308,7 +363,6 @@ def partition_graph(
         src[i, :k] = src_mapped[lo:hi]
         dst_local[i, :k] = graph.dst[lo:hi] - bounds_nodes[i]
         valid[i, :k] = 1.0
-    pad_frac = (d * e_dev - e) / max(d * e_dev, 1)
     inv = np.zeros(n_pad, dtype)
     inv[node_map] = inv_g
     dangling = np.zeros(n_pad, dtype)

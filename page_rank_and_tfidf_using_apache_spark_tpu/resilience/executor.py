@@ -107,7 +107,13 @@ _TRANSIENT_MARKERS = (
 def is_transient(exc: BaseException) -> bool:
     if isinstance(exc, (chaos.ChaosError, SyncDeadlineExceeded)):
         return True
-    if isinstance(exc, chaos.DeviceLostError):
+    # A fully-exhausted inner ladder is not transient by definition — and
+    # its message quotes the inner error, so the marker scan below would
+    # otherwise re-classify it.  Matters for nested guards: the delta
+    # fetch inside models/pagerank.py's invoke exhausts under the outer
+    # pagerank_step guard, whose retry must NOT re-dispatch (the runner
+    # donated its rank carry).
+    if isinstance(exc, (chaos.DeviceLostError, ResilienceExhausted)):
         return False
     return any(m in str(exc) for m in _TRANSIENT_MARKERS)
 

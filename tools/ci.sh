@@ -30,6 +30,20 @@ if [ "$dt" -gt "${GRAFT_SEMANTIC_BUDGET_S:-60}" ]; then
     exit 1
 fi
 
+echo "== graftlint tier 3 (cost model, budget ${GRAFT_COST_BUDGET_S:-10}s) =="
+# Static cost analysis (intensity floors / pad_frac budgets / donation
+# verifier) is all trace-time work and must stay interactive-fast: a cost
+# run that stops fitting its budget is itself a regression (a registry
+# builder started doing real work).
+t0=$(date +%s)
+tools/lint.sh --tier 3
+dt=$(( $(date +%s) - t0 ))
+echo "cost tier: ${dt}s"
+if [ "$dt" -gt "${GRAFT_COST_BUDGET_S:-10}" ]; then
+    echo "FAIL: cost tier exceeded its ${GRAFT_COST_BUDGET_S:-10}s budget (${dt}s)" >&2
+    exit 1
+fi
+
 echo "== traced-run smoke (obs + trace_report) =="
 # A tiny streaming TF-IDF run under GRAFT_TRACE_DIR must leave a JSONL
 # trace + manifest that tools/trace_report.py turns into a per-phase

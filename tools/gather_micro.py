@@ -45,6 +45,9 @@ def main() -> int:
                     help="~gathers per rep (web-Google edge count scale)")
     ap.add_argument("--out", type=str, default=None)
     ap.add_argument("--interpret", action="store_true")
+    ap.add_argument("--force", action="store_true",
+                    help="allow overwriting a TPU-measured --out artifact "
+                         "with a non-TPU run (utils/artifacts.py guard)")
     args = ap.parse_args()
 
     import jax
@@ -53,10 +56,19 @@ def main() -> int:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils import artifacts
+
     reps = args.reps
     rng = np.random.default_rng(0)
-    print(f"backend={jax.default_backend()} reps={reps}", file=sys.stderr,
-          flush=True)
+    backend = jax.default_backend()
+    print(f"backend={backend} reps={reps}", file=sys.stderr, flush=True)
+    try:
+        # fail FAST, before minutes of measurement, if the write would
+        # downgrade a TPU-stamped artifact
+        artifacts.check_overwrite(args.out, backend, force=args.force)
+    except artifacts.ProvenanceError as exc:
+        print(f"REFUSED: {exc}", file=sys.stderr)
+        return 3
 
     def make_runner(width, steps, axis, broadcast):
         rows = 8
@@ -161,13 +173,15 @@ def main() -> int:
 
     ok = {k: v for k, v in t.items() if v.get("compile_ok")}
     best = min(ok, key=lambda k: ok[k]["ns_per_gather"]) if ok else None
-    result = {"backend": jax.default_backend(), "reps": reps, "modes": t,
-              "best_mode": best, "widest_lane_ok": widest_ok}
-    line = json.dumps(result)
-    print(line)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+    payload = {"reps": reps, "modes": t, "best_mode": best,
+               "widest_lane_ok": widest_ok}
+    print(json.dumps({"backend": backend, **payload}))  # stdout regardless
+    try:
+        artifacts.write_artifact(args.out, payload, backend=backend,
+                                 force=args.force)
+    except artifacts.ProvenanceError as exc:  # raced stamp change
+        print(f"REFUSED: {exc}", file=sys.stderr)
+        return 3
     return 0
 
 

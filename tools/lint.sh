@@ -1,15 +1,19 @@
 #!/usr/bin/env bash
 # graftlint CI gate: fail on any finding not frozen in analysis/baseline.json.
 #
-# Runs BOTH analysis tiers over the tier-1 surface (the package, tools/,
-# bench.py): the lexical AST rules and the semantic tier that traces every
-# registered jit entry point on the CPU backend (recompile / promotion /
-# transfer-census / sharding gates).  Exit 0 = clean under the ratchet;
-# exit 1 = new findings — fix them, suppress with a justified
+# Runs ALL analysis tiers over the tier-1 surface (the package, tools/,
+# bench.py): the lexical AST rules (tier 1), the semantic tier that traces
+# every registered jit entry point on the CPU backend (tier 2: recompile /
+# promotion / transfer-census / sharding gates), and the static cost model
+# (tier 3: FLOP/byte intensity floors, pad_frac budgets over the partition
+# plans, and the buffer-donation verifier — intensity gates are advisory
+# while xla_cost_tpu.json is not TPU-measured).  Exit 0 = clean under the
+# ratchet; exit 1 = new findings — fix them, suppress with a justified
 # "# graftlint: disable=<rule>" comment (lexical) or a registry-level
-# suppress entry (semantic), or (outside ops//parallel/) baseline them
-# with a justification.  Pass --tier 1|2 to run a single tier,
-# --changed-only for the fast pre-commit path (tools/precommit.sh).
+# suppress entry (semantic/cost), or (outside ops//parallel/) baseline
+# them with a justification.  Pass --tier 1|2|3 to run a single tier,
+# --changed-only for the fast pre-commit path (tools/precommit.sh),
+# --cost-report for the tier-3 per-entry cost table.
 #
 # PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
 # can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).

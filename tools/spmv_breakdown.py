@@ -38,6 +38,9 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--out", type=str, default=None,
                     help="also write the JSON table to this path")
+    ap.add_argument("--force", action="store_true",
+                    help="allow overwriting a TPU-measured --out artifact "
+                         "with a non-TPU run (utils/artifacts.py guard)")
     args = ap.parse_args()
 
     import jax
@@ -48,6 +51,16 @@ def main() -> int:
     from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
     from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import DanglingMode
 
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils import artifacts
+
+    backend = jax.default_backend()
+    try:
+        # fail FAST, before minutes of measurement, if the write would
+        # downgrade a TPU-stamped artifact
+        artifacts.check_overwrite(args.out, backend, force=args.force)
+    except artifacts.ProvenanceError as exc:
+        print(f"REFUSED: {exc}", file=sys.stderr)
+        return 3
     reps = args.reps
     g = synthetic_powerlaw(args.nodes, args.edges, seed=args.seed)
     n, n_edges = g.n_nodes, g.n_edges
@@ -55,7 +68,7 @@ def main() -> int:
     rng = np.random.default_rng(0)
     w = jnp.asarray(rng.random(n).astype(np.float32))
     pe = jnp.asarray(rng.random(n_edges).astype(np.float32))
-    print(f"backend={jax.default_backend()} n={n} E={n_edges} reps={reps}",
+    print(f"backend={backend} n={n} E={n_edges} reps={reps}",
           file=sys.stderr, flush=True)
 
     def timed(name, make_body, *arrays):
@@ -135,8 +148,7 @@ def main() -> int:
     # could come from a path the winning impl never executes (VERDICT r5).
     cumsum_path = ("gather_w_src", "cumsum_E", "monotone_diff_N")
     segment_path = ("gather_w_src", "segment_sum_E_to_N")
-    result = {
-        "backend": jax.default_backend(),
+    payload = {
         "n_nodes": n,
         "n_edges": n_edges,
         "reps": reps,
@@ -147,11 +159,13 @@ def main() -> int:
         "dominant_component_segment_path": max(
             segment_path, key=lambda k: table[k]),
     }
-    line = json.dumps(result)
-    print(line)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+    print(json.dumps({"backend": backend, **payload}))  # stdout regardless
+    try:
+        artifacts.write_artifact(args.out, payload, backend=backend,
+                                 force=args.force)
+    except artifacts.ProvenanceError as exc:  # raced stamp change
+        print(f"REFUSED: {exc}", file=sys.stderr)
+        return 3
     return 0
 
 

@@ -1,0 +1,148 @@
+"""Per-phase wall-time regression attribution between two bench rounds.
+
+Takes two BENCH artifacts — either round records (``BENCH_rNN.json``, whose
+``extra.breakdown`` the bench parent derives from the tfidf child's trace)
+or raw trace files (``*.trace.jsonl``, re-derived here via
+tools/trace_report.py) — and answers the question a slower round always
+raises: *which phase* paid for it.  This is the comparison layer over the
+per-phase breakdowns the obs/ subsystem already records; nothing is
+re-measured.
+
+Stdlib-only (importable from the jax-free bench parent, same rule as
+trace_report.py).
+
+Usage::
+
+    python tools/trace_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/trace_diff.py old/tfidf.123.trace.jsonl new/tfidf.456.trace.jsonl
+    python tools/trace_diff.py A B --json [--threshold 0.10]
+
+Exit codes: 0 = no phase regressed past --threshold, 1 = at least one did,
+2 = artifacts unreadable/incomparable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+
+def _trace_report():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "trace_report.py")
+    spec = importlib.util.spec_from_file_location("trace_diff_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def load_breakdown(path: str) -> tuple[dict[str, float], float | None, str]:
+    """(phase -> secs, total wall secs or None, source kind) from either a
+    BENCH round record or a raw JSONL trace artifact."""
+    if path.endswith(".jsonl"):
+        rep = _trace_report().report(path)
+        if rep.get("empty"):
+            raise ValueError(f"{path}: empty trace")
+        return dict(rep["breakdown"]), float(rep["wall_secs"]), "trace"
+    with open(path) as f:
+        record = json.load(f)
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]  # driver-wrapped BENCH_rNN.json round
+    extra = record.get("extra", {})
+    breakdown = extra.get("breakdown")
+    if not breakdown:
+        raise ValueError(
+            f"{path}: no extra.breakdown (pre-PR-4 round, or the tfidf "
+            "child left no trace artifact)"
+        )
+    return (
+        {k: float(v) for k, v in breakdown.items()},
+        extra.get("breakdown_wall_secs"),
+        "bench",
+    )
+
+
+def diff_breakdowns(
+    old: dict[str, float], new: dict[str, float]
+) -> list[dict]:
+    """Per-phase rows sorted by absolute regression (worst first).  Phases
+    present on only one side diff against 0 — a phase appearing or
+    disappearing IS an attribution, not an error."""
+    rows = []
+    for phase in sorted(set(old) | set(new)):
+        a, b = old.get(phase, 0.0), new.get(phase, 0.0)
+        delta = b - a
+        rows.append({
+            "phase": phase,
+            "old_secs": round(a, 3),
+            "new_secs": round(b, 3),
+            "delta_secs": round(delta, 3),
+            # relative to the OLD total phase time; None for new phases
+            "delta_frac": round(delta / a, 4) if a > 0 else None,
+        })
+    rows.sort(key=lambda r: abs(r["delta_secs"]), reverse=True)
+    return rows
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="trace_diff", description=__doc__)
+    ap.add_argument("old", help="baseline BENCH_*.json or *.trace.jsonl")
+    ap.add_argument("new", help="candidate BENCH_*.json or *.trace.jsonl")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="per-phase relative regression that fails the "
+                         "diff (default 0.10 = +10%% on that phase)")
+    ap.add_argument("--min-secs", type=float, default=0.05,
+                    help="ignore phases below this absolute delta "
+                         "(default 0.05s: jitter, not regressions)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    try:
+        old_bd, old_wall, old_kind = load_breakdown(args.old)
+        new_bd, new_wall, new_kind = load_breakdown(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"trace_diff: {exc}", file=sys.stderr)
+        return 2
+
+    rows = diff_breakdowns(old_bd, new_bd)
+    regressions = [
+        r for r in rows
+        if r["delta_secs"] > args.min_secs
+        and (r["delta_frac"] is None or r["delta_frac"] > args.threshold)
+    ]
+    result = {
+        "old": {"path": args.old, "kind": old_kind, "wall_secs": old_wall},
+        "new": {"path": args.new, "kind": new_kind, "wall_secs": new_wall},
+        "phases": rows,
+        "regressions": [r["phase"] for r in regressions],
+        "worst_regression": regressions[0]["phase"] if regressions else None,
+    }
+
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        wall = ""
+        if old_wall is not None and new_wall is not None:
+            wall = f"  (wall {old_wall:.3f}s -> {new_wall:.3f}s)"
+        print(f"trace_diff: {args.old} -> {args.new}{wall}")
+        print(f"{'phase':28s} {'old':>9s} {'new':>9s} {'delta':>9s}  rel")
+        for r in rows:
+            rel = ("   new" if r["old_secs"] == 0
+                   else "  gone" if r["new_secs"] == 0
+                   else f"{r['delta_frac']:+.1%}")
+            mark = " <-- REGRESSED" if r["phase"] in result["regressions"] else ""
+            print(f"{r['phase']:28s} {r['old_secs']:9.3f} {r['new_secs']:9.3f} "
+                  f"{r['delta_secs']:+9.3f}  {rel}{mark}")
+        if regressions:
+            print(f"trace_diff: {len(regressions)} phase(s) regressed past "
+                  f"+{args.threshold:.0%}; worst: {result['worst_regression']}")
+        else:
+            print("trace_diff: no phase regressed past the threshold")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,16 +37,29 @@ def main() -> int:
     ap.add_argument("--nodes", type=int, default=872_511)
     ap.add_argument("--reps", type=int, default=20)
     ap.add_argument("--out", type=str, default=None)
+    ap.add_argument("--force", action="store_true",
+                    help="allow overwriting a TPU-measured --out artifact "
+                         "with a non-TPU run (utils/artifacts.py guard)")
     args = ap.parse_args()
 
     import jax
     import jax.numpy as jnp
     from jax import lax
 
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils import artifacts
+
     E, N, reps = args.edges, args.nodes, args.reps
     rng = np.random.default_rng(0)
-    print(f"backend={jax.default_backend()} E={E} N={N} reps={reps}",
+    backend = jax.default_backend()
+    print(f"backend={backend} E={E} N={N} reps={reps}",
           file=sys.stderr, flush=True)
+    try:
+        # fail FAST, before minutes of measurement, if the write would
+        # downgrade a TPU-stamped artifact
+        artifacts.check_overwrite(args.out, backend, force=args.force)
+    except artifacts.ProvenanceError as exc:
+        print(f"REFUSED: {exc}", file=sys.stderr)
+        return 3
 
     def timed(name, make_body, *arrays, elems=None):
         def run_n(r):
@@ -133,13 +146,14 @@ def main() -> int:
         "transpose (R,128)->(128,R)",
         lambda x: x.T.reshape(n_rows, 128), xr, elems=n_rows * 128)
 
-    result = {"backend": jax.default_backend(), "E": E, "N": N,
-              "reps": reps, "ops": t}
-    line = json.dumps(result)
-    print(line)
-    if args.out:
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+    payload = {"E": E, "N": N, "reps": reps, "ops": t}
+    print(json.dumps({"backend": backend, **payload}))  # stdout regardless
+    try:
+        artifacts.write_artifact(args.out, payload, backend=backend,
+                                 force=args.force)
+    except artifacts.ProvenanceError as exc:  # raced stamp change
+        print(f"REFUSED: {exc}", file=sys.stderr)
+        return 3
     return 0
 
 
