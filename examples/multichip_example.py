@@ -37,7 +37,8 @@ single = run_pagerank(graph, cfg).ranks
 
 print(f"mesh: {d} devices; auto strategy -> "
       f"{auto_select_strategy(graph, d)!r}")
-for strategy in ("edges", "nodes", "nodes_balanced", "src", "src_ring"):
+for strategy in ("edges", "nodes", "nodes_balanced", "src", "src_ring",
+                 "hybrid"):
     res = run_pagerank_sharded(graph, cfg, mesh=mesh, strategy=strategy)
     l1 = np.abs(res.ranks - single).sum()
     print(f"pagerank[{strategy:14s}] on {d} devices: L1 vs single-chip {l1:.2e}")

@@ -215,22 +215,27 @@ def _sharded_pagerank_traceable(strategy: str) -> Traceable:
         mesh = make_mesh(d, NODES_AXIS)
         sg = ps.partition_graph(graph, d, strategy=strategy)
         runners[d] = ps.make_sharded_runner(sg, cfg, mesh)
+        head = (
+            (_i32(sg.head_src.shape), _i32(sg.head_node.shape))
+            if strategy == "hybrid" else ()
+        )
         args = (
             _f32((sg.n_pad,)),
             _i32(sg.src.shape),
             _i32(sg.dst.shape),
             _f32(sg.valid.shape),
             _i32(sg.local_indptr.shape),
+            *head,
             _f32((sg.n_pad,)),
             _f32((sg.n_pad,)),
             _f32((sg.n_pad,)),
         )
         variants.append((f"{strategy}-d{d}", args))
 
-    def dispatch(ranks, src, dst, valid, ip, inv, dang, e):
+    def dispatch(ranks, src, *rest):
         # per-device-count runners: the edge arrays are [d, e_dev], so the
         # leading dim names which compiled program this variant exercises
-        return runners[src.shape[0]](ranks, src, dst, valid, ip, inv, dang, e)
+        return runners[src.shape[0]](ranks, src, *rest)
 
     return Traceable(
         fn=dispatch,
@@ -277,8 +282,98 @@ def _chunk_pad_plan() -> "list[tuple[str, float]]":
     return stream_pad_plan(CHUNK_TOKEN_MATRIX)
 
 
+def _layout_device_graph_spec(layout: str):
+    """DeviceGraph spec INCLUDING the static SpMV layout arrays: the
+    layout shapes are graph-dependent, so they come from a real host
+    build on the registry's trace graph (seed 1 — the same graph the
+    sharded entries partition)."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
+        synthetic_powerlaw,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+
+    graph = synthetic_powerlaw(64, 256, seed=1)
+    # production (models.pagerank.put_graph_for) skips the raw edge
+    # arrays for layout-backed impls — mirror that in the traced spec
+    base = _device_graph_spec(graph.n_nodes, graph.n_edges)._replace(
+        src=_i32((0,)), dst=_i32((0,)), indptr=_i32((0,))
+    )
+    if layout == "hybrid":
+        hl = ops.build_hybrid_layout(graph)
+        hybrid = ops.HybridLayout(
+            head_ids=_i32(hl.head_ids.shape),
+            head_src=_i32(hl.head_src.shape),
+            head_row_node=_i32(hl.head_row_node.shape),
+            tail_src=_i32(hl.tail_src.shape),
+            tail_dst=_i32(hl.tail_dst.shape),
+            tail_indptr=_i32(hl.tail_indptr.shape),
+        )
+        return graph.n_nodes, base._replace(hybrid=hybrid)
+    bucket_src, bucket_node = ops.build_shuffle_layout(graph)
+    shuffle = ops.ShuffleLayout(
+        bucket_src=_i32(bucket_src.shape), bucket_node=_i32(bucket_node.shape)
+    )
+    return graph.n_nodes, base._replace(shuffle=shuffle)
+
+
+def _build_pagerank_hybrid() -> Traceable:
+    """The degree-aware hybrid SpMV fixpoint runner: dense MXU head rows +
+    segment tail (ops.spmv_hybrid), traced with the real layout shapes."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
+
+    n, dg = _layout_device_graph_spec("hybrid")
+    cfg = PageRankConfig(iterations=4, dangling="redistribute",
+                         init="uniform", spmv_impl="hybrid")
+    run = ops.make_pagerank_runner(n, cfg)
+    return Traceable(
+        fn=run,
+        variants=[("n64-hybrid", (dg, _f32((n,)), _f32((n,))))],
+        anchor=ops.spmv_hybrid,
+    )
+
+
+def _build_pagerank_sort_shuffle() -> Traceable:
+    """The sort-based static-shuffle SpMV fixpoint runner: fixed-width
+    dst buckets, pure reshape->reduce contribution side."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
+
+    n, dg = _layout_device_graph_spec("sort_shuffle")
+    cfg = PageRankConfig(iterations=4, dangling="redistribute",
+                         init="uniform", spmv_impl="sort_shuffle")
+    run = ops.make_pagerank_runner(n, cfg)
+    return Traceable(
+        fn=run,
+        variants=[("n64-shuffle", (dg, _f32((n,)), _f32((n,))))],
+        anchor=ops.spmv_sort_shuffle,
+    )
+
+
+def _build_pagerank_rowsum_pallas() -> Traceable:
+    """The hybrid head's Pallas row-reduction kernel in interpret mode —
+    tier-2/3 coverage of the on-chip dense reduce without a chip (the
+    production hybrid path only takes it on a real TPU backend)."""
+    import functools
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import (
+        pallas_kernels as pk,
+    )
+
+    fn = functools.partial(pk.rowsum_pallas, interpret=True)
+    return Traceable(
+        fn=fn,
+        variants=[("r2048xw128", (_f32((2048, 128)),))],
+        anchor=pk.rowsum_pallas,
+    )
+
+
 def _build_pagerank_sharded_edges() -> Traceable:
     return _sharded_pagerank_traceable("edges")
+
+
+def _build_pagerank_sharded_hybrid() -> Traceable:
+    return _sharded_pagerank_traceable("hybrid")
 
 
 def _build_pagerank_sharded_nodes_balanced() -> Traceable:
@@ -535,6 +630,32 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         intensity_floor=0.04,  # static model measures 0.050
     ),
     EntryPoint(
+        name="pagerank_step_hybrid",
+        module=f"{_PKG}/ops/pagerank.py",
+        build=_build_pagerank_hybrid,
+        donate=(1,),
+        intensity_floor=0.05,  # static model measures 0.075
+    ),
+    EntryPoint(
+        name="pagerank_step_sort_shuffle",
+        module=f"{_PKG}/ops/pagerank.py",
+        build=_build_pagerank_sort_shuffle,
+        donate=(1,),
+        intensity_floor=0.05,  # static model measures 0.072
+    ),
+    EntryPoint(
+        name="pagerank_rowsum_pallas",
+        module=f"{_PKG}/ops/pallas_kernels.py",
+        build=_build_pagerank_rowsum_pallas,
+        # the hybrid impl routes its dense head through this kernel on a
+        # real TPU backend (ops.pagerank.hybrid_rowsum)
+        watch=(f"{_PKG}/ops/pagerank.py",),
+        # the model charges the pre-kernel pad copy as extra HBM traffic,
+        # so the static intensity is 0.050 (2 flops per element over ~2.5
+        # array passes), not the kernel's own 0.25
+        intensity_floor=0.045,
+    ),
+    EntryPoint(
         name="pagerank_sharded_edges",
         module=f"{_PKG}/parallel/pagerank_sharded.py",
         build=_build_pagerank_sharded_edges,
@@ -570,14 +691,39 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         collective_budget=3,
         # one compile per device count on the elastic shrink chain (4,2,1)
         max_compiles=3,
-        # Power-law in-degree concentrates edges: the capped equal-edge
-        # split still pads heavily on hub-dense tiny graphs (0.47 at d=4 on
-        # the trace graph; the 8-device dryrun measures 0.61).  This
-        # ceiling is the RATCHET SURFACE for the ROADMAP "pad_frac below
-        # 0.25" goal — tighten it as the hybrid partitioning work lands.
+        # RATCHETED with the hybrid/power-law PR: the optimal min-max
+        # boundary search (plan_partition) brought the trace-graph worst
+        # point from 0.47 to 0.10 at d=4 (and the 8-device dryrun plan
+        # from 0.61 to 0.47, its node-granularity floor — one hub's
+        # in-edge run cannot split across devices in this layout; the
+        # 'hybrid' strategy exists to go below that floor).
         pad_plan=_sharded_pad_plan("nodes_balanced"),
-        pad_frac_ceiling=0.50,
+        pad_frac_ceiling=0.25,
         intensity_floor=0.035,  # static model: 0.045 at d=4 (worst)
+    ),
+    EntryPoint(
+        name="pagerank_sharded_hybrid",
+        module=f"{_PKG}/parallel/pagerank_sharded.py",
+        build=_build_pagerank_sharded_hybrid,
+        watch=(
+            f"{_PKG}/ops/pagerank.py",
+            f"{_PKG}/parallel/mesh.py",
+            f"{_PKG}/parallel/collectives.py",
+            f"{_PKG}/parallel/compat.py",
+        ),
+        axes=("nodes",),
+        # one psum combines head + tail partials (replicated state needs
+        # no dangling-mass or delta collective)
+        collective_budget=1,
+        # one compile per device count on the elastic shrink chain (4,2,1)
+        max_compiles=3,
+        # row/edge-granular splits: only dense-row sentinels and two ceil
+        # remainders pad (0.21 at d=4 on the hub-dense 256-edge trace
+        # graph; 0.0001 at web-Google scale, where the ROADMAP "pad_frac
+        # below 0.25 for the balanced strategies" goal is measured)
+        pad_plan=_sharded_pad_plan("hybrid"),
+        pad_frac_ceiling=0.25,
+        intensity_floor=0.04,  # static model: 0.052 at d=4 (worst)
     ),
     EntryPoint(
         name="pagerank_sharded_src",

@@ -46,8 +46,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="personalized PageRank source node(s), as ORIGINAL "
                         "ids from the input file")
     p.add_argument("--spmv-impl",
-                   choices=["segment", "bcoo", "cumsum", "cumsum_mxu", "pallas"],
+                   choices=["segment", "bcoo", "cumsum", "cumsum_mxu",
+                            "hybrid", "sort_shuffle", "pallas"],
                    default="segment")
+    p.add_argument("--head-coverage", type=float, default=0.5,
+                   help="hybrid impl/strategy: edge-coverage threshold of "
+                        "the dense high-in-degree head (default 0.5)")
+    p.add_argument("--head-row-width", type=int, default=128,
+                   help="hybrid impl/strategy: dense row width (MXU lane "
+                        "count; adapts down on small graphs)")
     p.add_argument("--dtype", default="float32")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
@@ -61,13 +68,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard over this many devices (0 = single device)")
     p.add_argument("--shard-strategy",
                    choices=["auto", "edges", "nodes", "nodes_balanced",
-                            "src", "src_ring"],
+                            "src", "src_ring", "hybrid"],
                    default="auto",
                    help="graph partition under --mesh: auto (by memory "
-                        "footprint) / balanced edge slices / node blocks / "
-                        "edge-balanced node blocks (power-law) / source-"
-                        "block push with reduce-scatter (or explicit "
-                        "ppermute-ring) exchange")
+                        "footprint + degree shape) / balanced edge slices / "
+                        "node blocks / edge-balanced node blocks (power-law) "
+                        "/ source-block push with reduce-scatter (or "
+                        "explicit ppermute-ring) exchange / degree-aware "
+                        "hybrid (dense MXU head rows + tail edge slices)")
     return p
 
 
@@ -103,6 +111,8 @@ def _main(args) -> int:
         spark_exact=args.spark_exact,
         personalize=tuple(args.personalize) if args.personalize else None,
         spmv_impl=args.spmv_impl,
+        head_coverage=args.head_coverage,
+        head_row_width=args.head_row_width,
         dtype=args.dtype,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,

@@ -43,6 +43,42 @@ from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
 )
 
 
+class HybridLayout(NamedTuple):
+    """Static degree-aware head/tail split of the dst-sorted edge array
+    (*Sparse Allreduce*'s dense-head/sparse-tail decomposition of a
+    power-law degree distribution, blocked for the MXU per *RankMap*).
+
+    The **head** is the top-k in-degree destinations covering roughly
+    ``coverage`` of all edges (every one with in-degree >= the row width,
+    so a dense row is never mostly padding): each head node's in-edges are
+    chunked into fixed-width rows of ``head_src``, whose per-iteration
+    reduction is a single ``[R, W] @ [W]`` matvec on the MXU — the hot,
+    scatter-heavy rows of the power-law distribution stop touching the
+    scatter path entirely.  The **tail** keeps the sorted-segment layout.
+    Sentinel source id ``n`` points at the zero slot of the extended
+    weight vector, so padding needs no mask."""
+
+    head_ids: jax.Array  # int32 [H] head node ids (in-degree descending)
+    head_src: jax.Array  # int32 [R, W] per-row edge sources (sentinel n)
+    head_row_node: jax.Array  # int32 [R] row -> head slot, non-decreasing
+    tail_src: jax.Array  # int32 [Et]
+    tail_dst: jax.Array  # int32 [Et], non-decreasing
+    tail_indptr: jax.Array  # int32 [N+1] CSR pointers over the tail edges
+
+
+class ShuffleLayout(NamedTuple):
+    """Sort-based static-shuffle layout: the dst-sorted edge array padded
+    so every destination's run occupies whole fixed-width buckets.  The
+    per-iteration reduction is then a pure ``reshape -> reduce`` over the
+    bucket matrix plus a bucket-granular (B× smaller) sorted segment-sum —
+    no edge-granular scatter or prefix scan survives on the contribution
+    side.  Sentinel source id ``n`` reads the zero slot of the extended
+    weight vector."""
+
+    bucket_src: jax.Array  # int32 [NB, B] per-bucket edge sources
+    bucket_node: jax.Array  # int32 [NB] bucket -> dst node, non-decreasing
+
+
 class DeviceGraph(NamedTuple):
     """Device-resident graph state (the reference's ``links.cache()`` —
     SURVEY.md A3: built once, reused across all iterations)."""
@@ -53,22 +89,211 @@ class DeviceGraph(NamedTuple):
     dangling: jax.Array  # f[N], 1.0 where out_degree == 0
     has_outlinks: jax.Array  # f[N], 1.0 where out_degree > 0
     indptr: jax.Array | None = None  # int32 [N+1], CSR row pointers into dst
+    hybrid: HybridLayout | None = None  # spmv_impl='hybrid' static layout
+    shuffle: ShuffleLayout | None = None  # spmv_impl='sort_shuffle' layout
 
 
-def put_graph(graph: Graph, dtype: str = "float32") -> DeviceGraph:
-    """Host Graph → device arrays (one host→device transfer per run)."""
+def _pow2_floor(x: int) -> int:
+    return 1 << max(int(x).bit_length() - 1, 0)
+
+
+def plan_hybrid_head(
+    in_degree: np.ndarray,
+    n_edges: int,
+    *,
+    coverage: float = 0.5,
+    row_width: int = 128,
+) -> tuple[np.ndarray, int]:
+    """Head-membership policy shared by the single-chip layout builder and
+    the sharded partition *planner* (parallel/pagerank_sharded.py) — the
+    two must agree or the linted plan is not the materialized one.
+
+    Returns ``(head_order, W)``: node ids in in-degree-descending order
+    truncated to the head, and the effective row width.  The head is the
+    smallest top-k covering ``coverage`` of all edges, where every member
+    has in-degree >= W (a lower-degree node would make its dense row
+    mostly padding — those stay on the tail path).  W adapts downward to
+    the largest power of two <= the max in-degree so small graphs still
+    exercise the dense path."""
+    if n_edges == 0 or in_degree.size == 0:
+        return np.zeros(0, np.int64), max(8, row_width)
+    w = max(8, min(row_width, _pow2_floor(int(in_degree.max()))))
+    order = np.argsort(-in_degree, kind="stable")
+    deg_sorted = in_degree[order]
+    k_deg = int(np.searchsorted(-deg_sorted, -w, side="right"))
+    if k_deg == 0:
+        return np.zeros(0, np.int64), w
+    cum = np.cumsum(deg_sorted[:k_deg], dtype=np.int64)
+    k_cov = int(np.searchsorted(cum, coverage * n_edges, side="left")) + 1
+    k = min(k_deg, k_cov)
+    return order[:k].astype(np.int64), w
+
+
+class HybridHostLayout(NamedTuple):
+    """Numpy form of :class:`HybridLayout` plus its padding accounting —
+    built once on host at ``put_graph`` time (the amortized
+    ``spmv_preprocess_secs`` bench.py records)."""
+
+    head_ids: np.ndarray
+    head_src: np.ndarray
+    head_row_node: np.ndarray
+    tail_src: np.ndarray
+    tail_dst: np.ndarray
+    tail_indptr: np.ndarray
+    head_edges: int
+    pad_slots: int  # sentinel slots in the dense rows
+
+
+def build_hybrid_layout(
+    graph: Graph, *, coverage: float = 0.5, row_width: int = 128
+) -> HybridHostLayout:
+    """One-time host pass: degree sort -> head/tail split -> dense row
+    blocking.  O(E) after the cached csr_indptr; fully vectorized."""
+    n = graph.n_nodes
+    ip = graph.csr_indptr()
+    indeg = np.diff(ip)
+    head_ids, w = plan_hybrid_head(
+        indeg, graph.n_edges, coverage=coverage, row_width=row_width
+    )
+    in_head = np.zeros(n + 1, bool)
+    in_head[head_ids] = True
+
+    # dense head rows: each head node's in-edge run chunked into whole
+    # rows of width w, the last row padded with the sentinel id n.
+    # Vectorized like build_shuffle_layout: per-edge (row, col) from
+    # repeat/offset arithmetic, one fancy-index store for all head edges.
+    deg = indeg[head_ids] if head_ids.size else np.zeros(0, np.int64)
+    rows_per = -(-deg // w)
+    r = int(rows_per.sum())
+    head_src = np.full((r, w), n, np.int32)
+    head_row_node = np.repeat(
+        np.arange(head_ids.size, dtype=np.int64), rows_per
+    ).astype(np.int32)
+    if head_ids.size:
+        row_start = np.concatenate([[0], np.cumsum(rows_per)])
+        run_start = np.concatenate([[0], np.cumsum(deg)])
+        offs = np.arange(int(deg.sum()), dtype=np.int64) - np.repeat(
+            run_start[:-1], deg
+        )
+        e_idx = np.repeat(ip[head_ids], deg) + offs
+        head_src[np.repeat(row_start[:-1], deg) + offs // w, offs % w] = (
+            graph.src[e_idx]
+        )
+
+    keep = ~in_head[graph.dst]
+    tail_src = graph.src[keep].astype(np.int32)
+    tail_dst = graph.dst[keep].astype(np.int32)
+    tail_indptr = np.searchsorted(tail_dst, np.arange(n + 1)).astype(np.int32)
+    head_edges = int(graph.n_edges - tail_src.size)
+    return HybridHostLayout(
+        head_ids=head_ids.astype(np.int32),
+        head_src=head_src,
+        head_row_node=head_row_node,
+        tail_src=tail_src,
+        tail_dst=tail_dst,
+        tail_indptr=tail_indptr,
+        head_edges=head_edges,
+        pad_slots=r * w - head_edges,
+    )
+
+
+def build_shuffle_layout(graph: Graph, *, bucket_width: int = 8) -> tuple[
+    np.ndarray, np.ndarray
+]:
+    """One-time host pass for the sort-based static shuffle: pad every
+    destination's (already dst-sorted) edge run to whole buckets of width
+    ``bucket_width``.  Returns ``(bucket_src [NB, B], bucket_node [NB])``
+    — fully vectorized, no per-node python loop."""
+    n, e, b = graph.n_nodes, graph.n_edges, bucket_width
+    ip = graph.csr_indptr()
+    indeg = np.diff(ip)
+    buckets_per = -(-indeg // b)
+    nb = int(buckets_per.sum())
+    bucket_src = np.full((nb, b), n, np.int32)
+    bucket_node = np.repeat(
+        np.arange(n, dtype=np.int64), buckets_per
+    ).astype(np.int32)
+    if e:
+        # per-edge (row, col) inside its node's bucket block
+        offs = np.arange(e, dtype=np.int64) - np.repeat(ip[:-1], indeg)
+        bucket_start = np.concatenate([[0], np.cumsum(buckets_per)])
+        row = np.repeat(bucket_start[:-1], indeg) + offs // b
+        bucket_src[row, offs % b] = graph.src
+    return bucket_src, bucket_node
+
+
+def put_graph(
+    graph: Graph,
+    dtype: str = "float32",
+    *,
+    layout: str | None = None,
+    head_coverage: float = 0.5,
+    head_row_width: int = 128,
+    bucket_width: int = 8,
+    keep_edge_arrays: bool = True,
+) -> DeviceGraph:
+    """Host Graph → device arrays (one host→device transfer per run).
+
+    ``layout`` additionally builds the static SpMV layout an impl needs:
+    ``"hybrid"`` (degree-aware dense head + segment tail) or
+    ``"sort_shuffle"`` (fixed-width dst buckets).  See
+    :func:`layout_for_impl` for the impl -> layout mapping.
+
+    ``keep_edge_arrays=False`` skips the raw ``src``/``dst``/``indptr``
+    device upload (zero-length placeholders instead): the layout impls
+    never read them, and at bench scale they are ~3E dead int32 on HBM
+    plus transfer time — only valid when the caller commits to a
+    layout-backed impl (models.pagerank.put_graph_for does)."""
     outdeg = graph.out_degree.astype(dtype)
     with np.errstate(divide="ignore"):
         inv = np.where(outdeg > 0, 1.0 / np.maximum(outdeg, 1), 0.0).astype(dtype)
-    indptr = graph.csr_indptr().astype(np.int32)
+    if not keep_edge_arrays and layout is None:
+        raise ValueError("keep_edge_arrays=False requires a static layout")
+    src_h = graph.src if keep_edge_arrays else np.zeros(0, np.int32)
+    dst_h = graph.dst if keep_edge_arrays else np.zeros(0, np.int32)
+    indptr = (
+        graph.csr_indptr().astype(np.int32)
+        if keep_edge_arrays else np.zeros(0, np.int32)
+    )
+    hybrid = None
+    shuffle = None
+    if layout == "hybrid":
+        hl = build_hybrid_layout(
+            graph, coverage=head_coverage, row_width=head_row_width
+        )
+        hybrid = HybridLayout(
+            head_ids=jnp.asarray(hl.head_ids),
+            head_src=jnp.asarray(hl.head_src),
+            head_row_node=jnp.asarray(hl.head_row_node),
+            tail_src=jnp.asarray(hl.tail_src),
+            tail_dst=jnp.asarray(hl.tail_dst),
+            tail_indptr=jnp.asarray(hl.tail_indptr),
+        )
+    elif layout == "sort_shuffle":
+        bucket_src, bucket_node = build_shuffle_layout(
+            graph, bucket_width=bucket_width
+        )
+        shuffle = ShuffleLayout(
+            bucket_src=jnp.asarray(bucket_src),
+            bucket_node=jnp.asarray(bucket_node),
+        )
+    elif layout is not None:
+        raise ValueError(f"unknown graph layout {layout!r}")
     return DeviceGraph(
-        src=jnp.asarray(graph.src),
-        dst=jnp.asarray(graph.dst),
+        src=jnp.asarray(src_h),
+        dst=jnp.asarray(dst_h),
         inv_outdeg=jnp.asarray(inv),
         dangling=jnp.asarray((graph.out_degree == 0).astype(dtype)),
         has_outlinks=jnp.asarray((graph.out_degree > 0).astype(dtype)),
         indptr=jnp.asarray(indptr),
+        hybrid=hybrid,
+        shuffle=shuffle,
     )
+
+
+def layout_for_impl(impl: str) -> str | None:
+    """Which static layout ``put_graph`` must build for an spmv impl."""
+    return {"hybrid": "hybrid", "sort_shuffle": "sort_shuffle"}.get(impl)
 
 
 def restart_vector(n: int, cfg: PageRankConfig) -> np.ndarray:
@@ -178,6 +403,78 @@ def spmv_cumsum_mxu(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.A
                             cumsum_fn=cumsum_blocked)
 
 
+def hybrid_rowsum(rows: jax.Array) -> jax.Array:
+    """Dense-head row reduction: ``[R, W] -> [R]`` as one MXU matvec
+    against a ones vector (the RankMap-style blocked contraction).  On a
+    real TPU the Pallas kernel streams the row matrix through VMEM in one
+    HBM pass; elsewhere the plain dot is what XLA lowers best (the
+    interpreter at bench scale would be pointless)."""
+    if jax.default_backend() in ("tpu", "axon"):
+        from page_rank_and_tfidf_using_apache_spark_tpu.ops import (
+            pallas_kernels as pk,
+        )
+
+        return pk.rowsum_pallas(rows)
+    ones = jnp.ones((rows.shape[1],), rows.dtype)
+    return jnp.matmul(rows, ones, precision=jax.lax.Precision.HIGHEST)
+
+
+def spmv_hybrid(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
+    """Degree-aware hybrid SpMV: the high-in-degree head as a dense
+    ``[R, W]`` gather + MXU row reduction (zero scatter traffic for the
+    power-law hot rows), the long tail through the scatter-free
+    prefix-sum/monotone-diff path over its own CSR pointers, combined
+    with one scatter-add of H head totals.
+
+    Accuracy class: the head rows sum in fixed blocked order (segment
+    class — each node accumulates within its own rows only); the tail
+    inherits the prefix-sum class of :func:`spmv_cumsum`, but over only
+    the tail's mass — roughly half the accumulated error of the full
+    cumsum impl at the default 0.5 head coverage."""
+    hl = dg.hybrid
+    if hl is None:
+        raise ValueError("spmv_impl='hybrid' needs put_graph(layout='hybrid')")
+    if hl.tail_src.shape[0]:
+        contribs = cumsum_diff_spmv(weighted_ranks[hl.tail_src], hl.tail_indptr)
+    else:
+        contribs = jnp.zeros(n, weighted_ranks.dtype)
+    h = hl.head_ids.shape[0]
+    if h:
+        w_ext = jnp.concatenate(
+            [weighted_ranks, jnp.zeros(1, weighted_ranks.dtype)]
+        )
+        row_sums = hybrid_rowsum(w_ext[hl.head_src])
+        head = jax.ops.segment_sum(
+            row_sums, hl.head_row_node, num_segments=h, indices_are_sorted=True
+        )
+        contribs = contribs.at[hl.head_ids].add(head)
+    return contribs
+
+
+def spmv_sort_shuffle(
+    dg: DeviceGraph, weighted_ranks: jax.Array, n: int
+) -> jax.Array:
+    """Sort-based static-shuffle SpMV: with every destination's edge run
+    padded to whole fixed-width buckets at ``put_graph`` time, the
+    per-iteration contribution side is a pure ``reshape -> reduce`` over
+    the bucket matrix plus a bucket-granular sorted segment-sum — the
+    edge-granular scatter/prefix machinery shrinks by the bucket width."""
+    sl = dg.shuffle
+    if sl is None:
+        raise ValueError(
+            "spmv_impl='sort_shuffle' needs put_graph(layout='sort_shuffle')"
+        )
+    if sl.bucket_src.shape[0] == 0:
+        return jnp.zeros(n, weighted_ranks.dtype)
+    w_ext = jnp.concatenate(
+        [weighted_ranks, jnp.zeros(1, weighted_ranks.dtype)]
+    )
+    bucket_sums = w_ext[sl.bucket_src].sum(axis=1)
+    return jax.ops.segment_sum(
+        bucket_sums, sl.bucket_node, num_segments=n, indices_are_sorted=True
+    )
+
+
 def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
     if impl == "segment":
         return spmv_segment(dg, weighted, n)
@@ -187,6 +484,10 @@ def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
         return spmv_cumsum(dg, weighted, n)
     if impl == "cumsum_mxu":
         return spmv_cumsum_mxu(dg, weighted, n)
+    if impl == "hybrid":
+        return spmv_hybrid(dg, weighted, n)
+    if impl == "sort_shuffle":
+        return spmv_sort_shuffle(dg, weighted, n)
     if impl == "pallas":
         from page_rank_and_tfidf_using_apache_spark_tpu.ops import pallas_kernels as pk
 

@@ -11,7 +11,10 @@ O(log E) shifted-add passes — each a full HBM sweep — while a sequential
 grid with a scalar carry does it in exactly one read and one write of the
 edge array.  ``cumsum_pallas`` is that kernel; ``spmv_pallas`` composes it
 with the XLA gather/diff into the ``spmv_impl='pallas'`` variant raced by
-bench.py.
+bench.py.  ``rowsum_pallas`` is the hybrid impl's dense-head reduction
+(``ops/pagerank.py spmv_hybrid``): the gathered ``[R, W]`` per-edge weight
+matrix of the top-in-degree nodes streamed through VMEM in one HBM pass,
+each block reduced by a single MXU matvec.
 
 Lowering is validated without a chip via ``jax.export`` cross-platform
 lowering (tests/test_tpu_lowering.py).
@@ -95,6 +98,47 @@ def cumsum_pallas(x: jax.Array, *, interpret: bool = False) -> jax.Array:
         interpret=interpret,
     )(x_pad.reshape(1, e_pad))
     return out.reshape(e_pad)[:e]
+
+
+# Rows per grid step of the dense-head row reduction.  1024 x 128 f32 is
+# 512 KB of VMEM in, 4 KB out per step.
+_ROW_BLOCK = 1024
+
+
+def _rowsum_kernel(x_ref, o_ref):
+    """One block of dense-head rows: a single MXU matvec against a ones
+    vector reduces the lane dimension ([RB, W] @ [W, 1] -> [RB])."""
+    ones = jnp.ones((x_ref.shape[1], 1), x_ref.dtype)
+    o_ref[:] = jax.lax.dot(
+        x_ref[:], ones, precision=jax.lax.Precision.HIGHEST
+    ).reshape(1, -1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def rowsum_pallas(mat: jax.Array, *, interpret: bool = False) -> jax.Array:
+    """Row sums of the hybrid SpMV's dense head matrix in ONE HBM read.
+
+    The gathered ``[R, W]`` per-edge weight matrix streams through VMEM
+    block by block; each block's reduction is one systolic-array matvec —
+    the contraction shape RankMap's platform-aware blocking prescribes for
+    mapping a dense decomposition onto the MXU."""
+    r, w = mat.shape
+    if r == 0:
+        return jnp.zeros((0,), mat.dtype)
+    rb = min(_ROW_BLOCK, _round_up(r, 8))
+    r_pad = _round_up(r, rb)
+    mat_pad = jnp.zeros((r_pad, w), mat.dtype).at[:r].set(mat)
+    out = pl.pallas_call(
+        _rowsum_kernel,
+        grid=(r_pad // rb,),
+        in_specs=[
+            pl.BlockSpec((rb, w), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((1, rb), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, r_pad), mat.dtype),
+        interpret=interpret,
+    )(mat_pad)
+    return out.reshape(r_pad)[:r]
 
 
 def spmv_pallas(

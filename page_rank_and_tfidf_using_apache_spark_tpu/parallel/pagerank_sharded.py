@@ -41,9 +41,21 @@ both exist):
   ``src_ring`` runs the identical exchange as an explicit ``ppermute``
   ring (collectives.ring_reduce_scatter) — the hand-scheduled hop-by-hop
   form whose equality with psum_scatter tests pin.
-- ``auto``: picks by memory footprint — ``edges`` while the replicated
-  node state fits comfortably in per-chip HBM, ``nodes_balanced`` beyond
-  (see :func:`auto_select_strategy`).
+- ``hybrid``: the degree-aware power-law layout (*Sparse Allreduce*'s
+  dense-head/sparse-tail split, PAPERS.md).  Replicated rank vector like
+  ``edges``; the high-in-degree head's edges live as fixed-width dense
+  rows (ops.pagerank.HybridLayout) split evenly across devices and
+  reduced on the MXU, the long tail as equal contiguous dst-sorted edge
+  slices; each device's full-size partial combines in the same single
+  ``psum``.  Because BOTH sides split at edge/row granularity — a hub's
+  dense rows simply span devices — the power-law in-degree imbalance that
+  pads ``nodes``/``nodes_balanced`` to 0.6 cannot occur: the plan-level
+  ``pad_frac`` stays at the ceil-remainder level of ``edges`` plus the
+  head rows' sentinel slots.
+- ``auto``: picks by memory footprint and degree shape — ``hybrid`` when
+  the replicated node state fits per-chip HBM and the graph has a
+  dense-worthy power-law head, ``edges`` when it fits but has no head,
+  ``nodes_balanced`` beyond (see :func:`auto_select_strategy`).
 
 Both run the whole iteration loop inside one ``jit`` + ``shard_map``
 program: collectives are compiled into the loop body, so there are zero
@@ -98,6 +110,8 @@ def auto_select_strategy(
     *,
     dtype: str = "float32",
     hbm_bytes: int | None = None,
+    head_coverage: float = 0.5,
+    head_row_width: int = 128,
 ) -> str:
     """Pick a shard strategy by per-chip memory footprint.
 
@@ -120,6 +134,19 @@ def auto_select_strategy(
     edge_state = (graph.n_edges / max(n_devices, 1)) * (8 + item)
     if node_state + edge_state > hbm_bytes / 2:
         return "nodes_balanced"
+    # Replicated state fits — prefer the degree-aware hybrid layout when
+    # the graph has a dense-worthy power-law head covering a meaningful
+    # fraction of the edges (the dense MXU rows then carry the hot
+    # in-degree mass scatter-free); plain ``edges`` otherwise.
+    indeg = np.diff(graph.csr_indptr())
+    # evaluate the head at the SAME knobs the partition will materialize
+    # with — plan_hybrid_head's planner/builder agreement contract
+    head_ids, _w = ops.plan_hybrid_head(
+        indeg, graph.n_edges, coverage=head_coverage,
+        row_width=head_row_width,
+    )
+    if head_ids.size and int(indeg[head_ids].sum()) >= graph.n_edges // 4:
+        return "hybrid"
     return "edges"
 
 
@@ -139,24 +166,54 @@ class PartitionPlan(NamedTuple):
     n: int  # real node count
     n_pad: int  # D * block
     block: int  # nodes per device block
-    e_dev: int  # edge slots per device (padded width)
+    e_dev: int  # edge slots per device (padded width; tail-only for hybrid)
     pad_frac: float  # fraction of padded edge slots (load-imbalance gauge)
     bounds_nodes: np.ndarray | None = None  # [D+1] node-block boundaries
     ebounds: np.ndarray | None = None  # [D+1] edge-range boundaries (nodes*)
     per: np.ndarray | None = None  # [D] real edges per device ('src*')
+    # 'hybrid' only: (head node count, dense row width, total dense rows,
+    # dense rows per device) — the head side of the slot accounting
+    head: tuple[int, int, int, int] | None = None
 
 
 def plan_partition(
-    graph: Graph, n_devices: int, *, strategy: str = "edges"
+    graph: Graph,
+    n_devices: int,
+    *,
+    strategy: str = "edges",
+    head_coverage: float = 0.5,
+    head_row_width: int = 128,
 ) -> PartitionPlan:
     """Plan a partition without building it: boundaries, padded widths and
     ``pad_frac`` only — O(E) host work, no per-device arrays, no device
     traffic.  ``partition_graph`` materializes exactly this plan."""
-    if strategy not in ("edges", "nodes", "nodes_balanced", "src", "src_ring"):
+    if strategy not in ("edges", "nodes", "nodes_balanced", "src", "src_ring",
+                        "hybrid"):
         raise ValueError(f"unknown shard strategy {strategy!r}")
     d = n_devices
     n = graph.n_nodes
     e = graph.n_edges
+
+    if strategy == "hybrid":
+        # Replicated-state layout: head rows and tail edges both split at
+        # row/edge granularity, so the only padding is the dense rows'
+        # sentinel slots plus two ceil remainders.  pad_frac counts ALL
+        # dispatched slots (head row slots + tail edge slots) against the
+        # real edge count — comparable with the other strategies' gauge.
+        block = max(1, math.ceil(n / d))
+        indeg = np.diff(graph.csr_indptr())
+        head_ids, w = ops.plan_hybrid_head(
+            indeg, e, coverage=head_coverage, row_width=head_row_width
+        )
+        head_deg = indeg[head_ids]
+        rows = int((-(-head_deg // w)).sum()) if head_ids.size else 0
+        rows_dev = math.ceil(rows / d) if rows else 0
+        e_tail = e - int(head_deg.sum())
+        e_dev = max(1, math.ceil(e_tail / d))
+        slots = d * (e_dev + rows_dev * w)
+        pad_frac = (slots - e) / max(slots, 1)
+        return PartitionPlan(strategy, n, block * d, block, e_dev, pad_frac,
+                             head=(int(head_ids.size), int(w), rows, rows_dev))
 
     if strategy in ("src", "src_ring"):
         block = max(1, math.ceil(n / d))
@@ -178,23 +235,45 @@ def plan_partition(
         block = max(1, math.ceil(n / d))
         bounds_nodes = np.minimum(np.arange(0, d + 1) * block, n)
     else:  # nodes_balanced
-        # Equal-edge boundaries, but with per-device node count capped at
-        # 2x the equal-node block: the uniform padded block is the max
-        # device's node count, so an uncapped edge-balanced split of a
-        # hub-heavy graph (hubs first, a huge low-degree tail on the last
-        # device) would push n_pad toward n*d and forfeit the 1/D memory
-        # scaling this layout exists for.  The cap bounds memory at 2x the
-        # 'nodes' layout while keeping edges near-balanced whenever the
-        # degree distribution allows.
+        # OPTIMAL min-max contiguous split (binary search over the padded
+        # width + greedy max-fill feasibility), with per-device node count
+        # capped at 2x the equal-node block: the uniform padded block is
+        # the max device's node count, so an uncapped edge-balanced split
+        # of a hub-heavy graph would push n_pad toward n*d and forfeit the
+        # 1/D memory scaling this layout exists for.  The previous greedy
+        # target-then-clamp scan planned up to 3x more padding than the
+        # optimum on hub-heavy graphs (MULTICHIP_r05 measured 0.61 at 8
+        # devices where the optimum is 0.47, and 0.45 at 4 where it is
+        # 0.12); the node-granularity floor — a single hub's in-edge run
+        # cannot split across devices in this layout — is what remains
+        # (the 'hybrid' strategy exists to go below it).
         cap = 2 * max(1, math.ceil(n / d))
         indptr = graph.csr_indptr()
-        bounds_nodes = np.zeros(d + 1, np.int64)
-        for i in range(1, d):
-            target = int(np.searchsorted(indptr, (i * e) // d, side="left"))
-            lo = max(bounds_nodes[i - 1], n - (d - i) * cap)  # leave capacity
-            hi = min(bounds_nodes[i - 1] + cap, n)
-            bounds_nodes[i] = min(max(target, lo), hi)
-        bounds_nodes[d] = n
+
+        def fill(width: int) -> np.ndarray | None:
+            """Greedy max-fill at the given padded width; None = the n
+            nodes do not fit on d devices at this width."""
+            bounds = np.zeros(d + 1, np.int64)
+            b = 0
+            for i in range(d):
+                hi = int(np.searchsorted(
+                    indptr, indptr[b] + width, side="right")) - 1
+                hi = min(max(hi, b), b + cap, n)
+                bounds[i + 1] = hi
+                b = hi
+            return bounds if b >= n else None
+
+        lo_w = max(1, math.ceil(e / d))
+        hi_w = max(e, 1)
+        bounds_nodes = fill(hi_w)
+        assert bounds_nodes is not None  # d * cap >= 2n always covers n
+        while lo_w < hi_w:
+            mid = (lo_w + hi_w) // 2
+            bm = fill(mid)
+            if bm is None:
+                lo_w = mid + 1
+            else:
+                hi_w, bounds_nodes = mid, bm
         block = max(1, int(np.diff(bounds_nodes).max()))
     ebounds = np.searchsorted(graph.dst, bounds_nodes)
     e_dev = max(1, int(np.diff(ebounds).max()))
@@ -228,6 +307,11 @@ class ShardedGraph(NamedTuple):
     # pointers into that device's (sorted) edge slice, S = n_pad under
     # 'edges' / block under node strategies — the monotone-diff pointers
     # for spmv_impl='cumsum' (host memory cost D*S ints; sharded on device)
+    # 'hybrid' only: this device's slice of the dense head rows.  Sentinel
+    # source id n_pad reads the zero slot of the step's extended weight
+    # vector; all-sentinel padding rows scatter 0.0 into node 0.
+    head_src: np.ndarray | None = None  # int32 [D, R_dev, W]
+    head_node: np.ndarray | None = None  # int32 [D, R_dev] global dst ids
 
 
 def partition_graph(
@@ -237,6 +321,8 @@ def partition_graph(
     strategy: str = "edges",
     dtype: str = "float32",
     need_local_indptr: bool = True,
+    head_coverage: float = 0.5,
+    head_row_width: int = 128,
 ) -> ShardedGraph:
     """Partition once on host (the reference partitions on every shuffle).
 
@@ -248,7 +334,9 @@ def partition_graph(
     All split boundaries, padded widths and ``pad_frac`` come from
     :func:`plan_partition` — the static plan the tier-3 cost linter
     budgets is the one this function materializes."""
-    plan = plan_partition(graph, n_devices, strategy=strategy)
+    plan = plan_partition(graph, n_devices, strategy=strategy,
+                          head_coverage=head_coverage,
+                          head_row_width=head_row_width)
     d = n_devices
     n = graph.n_nodes
     e = graph.n_edges
@@ -260,6 +348,49 @@ def partition_graph(
         graph.out_degree > 0, 1.0 / np.maximum(graph.out_degree, 1), 0.0
     ).astype(dtype)
     dang_g = (graph.out_degree == 0).astype(dtype)
+
+    if strategy == "hybrid":
+        # Materialize exactly the planned head/tail split: the global
+        # hybrid layout (same plan_hybrid_head policy as the single-chip
+        # impl), its dense rows dealt to devices in equal contiguous row
+        # blocks, the tail as equal contiguous dst-sorted edge slices.
+        hl = ops.build_hybrid_layout(
+            graph, coverage=head_coverage, row_width=head_row_width
+        )
+        head_k, w, rows, rows_dev = plan.head
+        assert hl.head_src.shape == (rows, w)  # plan IS the layout
+        # head rows: remap the single-chip sentinel n -> n_pad (the zero
+        # slot of the sharded step's extended weight vector)
+        hsrc_g = hl.head_src.astype(np.int32).copy()
+        hsrc_g[hsrc_g == n] = n_pad
+        hnode_g = hl.head_ids[hl.head_row_node].astype(np.int32)
+        head_src = np.full((d, max(rows_dev, 1), max(w, 1)), n_pad, np.int32)
+        head_node = np.zeros((d, max(rows_dev, 1)), np.int32)
+        for i in range(d):
+            lo, hi = min(i * rows_dev, rows), min((i + 1) * rows_dev, rows)
+            head_src[i, : hi - lo, :w] = hsrc_g[lo:hi]
+            head_node[i, : hi - lo] = hnode_g[lo:hi]
+        # tail: equal contiguous slices of the tail edge array, 'edges'
+        # style (pad src=0 dst=n_pad-1 masked by valid)
+        e_tail = hl.tail_src.shape[0]
+        cap_t = e_dev * d
+        src = np.zeros(cap_t, np.int32)
+        dst = np.full(cap_t, n_pad - 1, np.int32)
+        valid = np.zeros(cap_t, dtype)
+        src[:e_tail] = hl.tail_src
+        dst[:e_tail] = hl.tail_dst
+        valid[:e_tail] = 1.0
+        inv = np.zeros(n_pad, dtype)
+        inv[:n] = inv_g
+        dangling = np.zeros(n_pad, dtype)
+        dangling[:n] = dang_g
+        return ShardedGraph(
+            strategy, n, n_pad, block,
+            src.reshape(d, e_dev), dst.reshape(d, e_dev),
+            valid.reshape(d, e_dev), inv, dangling, pad_frac,
+            np.arange(n, dtype=np.int64), np.zeros((d, 1), np.int32),
+            head_src=head_src, head_node=head_node,
+        )
 
     if strategy in ("src", "src_ring"):
         # Push layout: device i owns SOURCE block [i*block, (i+1)*block) —
@@ -429,6 +560,7 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
             per_edge, dst_row, num_segments=num_segments, indices_are_sorted=True
         )
 
+    head_specs: tuple = ()
     if sg.strategy == "edges":
         # state: replicated full rank vector; one psum per iteration.
         def step(ranks, src, dst, valid, ip, inv, dang, e):
@@ -442,6 +574,38 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
 
         state_spec = P()  # replicated ranks
         vec_spec = P()  # inv/dangling/e replicated (step reads the full vectors)
+        local_delta = lambda new, old: jnp.sum(jnp.abs(new - old))
+    elif sg.strategy == "hybrid":
+        # Degree-aware power-law layout: replicated ranks like 'edges';
+        # this device's dense head rows reduce on the MXU (one matvec, no
+        # scatter for the hot in-degree mass), its tail slice through the
+        # sorted segment path, both into the same full-size partial — ONE
+        # psum combines everything across chips.
+        # a headless graph (uniform degrees) materializes one all-sentinel
+        # placeholder row per device — skip the dense path entirely then,
+        # not just when the padded shape is empty (it never is)
+        has_head = bool((np.asarray(sg.head_src) != sg.n_pad).any())
+
+        def step(ranks, src, dst, valid, ip, hsrc, hnode, inv, dang, e):
+            weighted = ranks * inv
+            per_edge = weighted[src[0]] * valid[0]
+            partial = jax.ops.segment_sum(
+                per_edge, dst[0], num_segments=n_pad, indices_are_sorted=True
+            )
+            if has_head:
+                w_ext = jnp.concatenate(
+                    [weighted, jnp.zeros(1, weighted.dtype)]
+                )
+                row_sums = ops.hybrid_rowsum(w_ext[hsrc[0]])
+                partial = partial.at[hnode[0]].add(row_sums)
+            contribs = coll.psum(partial, axis)
+            if redistribute:
+                contribs = contribs + jnp.sum(ranks * dang) * e
+            return (1.0 - damping) * total_mass * e + damping * contribs
+
+        head_specs = (P(axis, None, None), P(axis, None))
+        state_spec = P()
+        vec_spec = P()
         local_delta = lambda new, old: jnp.sum(jnp.abs(new - old))
     elif sg.strategy in ("src", "src_ring"):
         # Push layout: gather from the LOCAL rank block only, segment-sum
@@ -485,7 +649,7 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
         vec_spec = P(axis)
         local_delta = lambda new, old: coll.psum(jnp.sum(jnp.abs(new - old)), axis)
 
-    def loop(ranks0, src, dst, valid, ip, inv, dang, e):
+    def loop(ranks0, *arrays):
         if cfg.tol > 0.0:
             def cond(carry):
                 _, delta, it = carry
@@ -493,7 +657,7 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
 
             def body(carry):
                 ranks, _, it = carry
-                new = step(ranks, src, dst, valid, ip, inv, dang, e)
+                new = step(ranks, *arrays)
                 return new, local_delta(new, ranks), it + 1
 
             init = (ranks0, jnp.array(jnp.inf, ranks0.dtype), jnp.array(0, jnp.int32))
@@ -501,7 +665,7 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
             return ranks, it, delta
 
         def body(ranks, _):
-            new = step(ranks, src, dst, valid, ip, inv, dang, e)
+            new = step(ranks, *arrays)
             return new, local_delta(new, ranks)
 
         ranks, deltas = lax.scan(body, ranks0, None, length=cfg.iterations)
@@ -513,7 +677,7 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
         loop,
         mesh=mesh,
         in_specs=(state_spec, edge_spec, edge_spec, edge_spec, edge_spec,
-                  vec_spec, vec_spec, vec_spec),
+                  *head_specs, vec_spec, vec_spec, vec_spec),
         out_specs=(state_spec, P(), P()),
         check_vma=False,
     )
@@ -523,18 +687,24 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
 def device_put_sharded_graph(sg: ShardedGraph, mesh: Mesh):
     axis = mesh.axis_names[0]
     esh = NamedSharding(mesh, P(axis, None))
-    # Node-state vectors follow the strategy: replicated under ``edges``
-    # (the step reads the full vectors), node-sharded under ``nodes`` (1/D
-    # per-chip HBM — the strategy's reason to exist).
-    vsh = NamedSharding(mesh, P() if sg.strategy == "edges" else P(axis))
-    return (
+    # Node-state vectors follow the strategy: replicated under ``edges`` /
+    # ``hybrid`` (the step reads the full vectors), node-sharded under
+    # ``nodes`` (1/D per-chip HBM — the strategy's reason to exist).
+    replicated_state = sg.strategy in ("edges", "hybrid")
+    vsh = NamedSharding(mesh, P() if replicated_state else P(axis))
+    out = [
         jax.device_put(sg.src, esh),
         jax.device_put(sg.dst, esh),
         jax.device_put(sg.valid, esh),
         jax.device_put(sg.local_indptr, esh),
-        jax.device_put(sg.inv_outdeg, vsh),
-        jax.device_put(sg.dangling, vsh),
-    )
+    ]
+    if sg.strategy == "hybrid":
+        out.append(jax.device_put(sg.head_src,
+                                  NamedSharding(mesh, P(axis, None, None))))
+        out.append(jax.device_put(sg.head_node, esh))
+    out.append(jax.device_put(sg.inv_outdeg, vsh))
+    out.append(jax.device_put(sg.dangling, vsh))
+    return tuple(out)
 
 
 class _ShardedExec:
@@ -550,7 +720,12 @@ class _ShardedExec:
         with Timer() as t_part:
             self.sg = partition_graph(
                 graph, self.d, strategy=strategy, dtype=cfg.dtype,
-                need_local_indptr=cfg.spmv_impl in ("cumsum", "cumsum_mxu"),
+                need_local_indptr=(
+                    cfg.spmv_impl in ("cumsum", "cumsum_mxu")
+                    and strategy != "hybrid"
+                ),
+                head_coverage=cfg.head_coverage,
+                head_row_width=cfg.head_row_width,
             )
             self.dev = device_put_sharded_graph(self.sg, mesh)
         metrics.record(
@@ -560,7 +735,8 @@ class _ShardedExec:
         )
         axis = mesh.axis_names[0]
         self.state_sharding = (
-            NamedSharding(mesh, P()) if self.sg.strategy == "edges"
+            NamedSharding(mesh, P())
+            if self.sg.strategy in ("edges", "hybrid")
             else NamedSharding(mesh, P(axis))
         )
         self.e_vec = jax.device_put(_restart_padded(self.sg, cfg),
@@ -678,7 +854,11 @@ def run_pagerank_sharded(
     if graph.n_nodes == 0:
         return PageRankResult(np.zeros(0, cfg.dtype), 0, 0.0, metrics)
     if strategy == "auto":
-        strategy = auto_select_strategy(graph, d, dtype=cfg.dtype)
+        strategy = auto_select_strategy(
+            graph, d, dtype=cfg.dtype,
+            head_coverage=cfg.head_coverage,
+            head_row_width=cfg.head_row_width,
+        )
         metrics.record(event="auto_strategy", chosen=strategy, devices=d)
     cfg = driver.resolve_personalize(graph, cfg)
 
@@ -706,12 +886,55 @@ def run_pagerank_sharded(
             graph, cfg, strategy, metrics, exec_box
         ),
     )
-    exec_ = exec_box["exec"]  # the elastic rung may have swapped it
+    # Device loss FIRST surfacing at the result pull (no segment dispatch
+    # left to catch it) used to exhaust the ladder; this rung routes the
+    # pull through the same elastic shrink: salvage the newest checkpoint
+    # (the live buffers died with the device), rebuild over the survivors,
+    # re-run the uncommitted iterations there, and pull from the rebuilt
+    # mesh.  The rung swaps exec_box so the node_map below matches the
+    # layout the returned padded ranks were produced in.
+    def pull_rebuild(exc):
+        if not elastic.enabled() or not elastic.is_device_loss(exc):
+            raise exc
+        idx = elastic.device_index(exc)
+        if idx is not None:
+            elastic.health().mark_lost(idx)
+        old = exec_box["exec"]
+        at_iter, ranks_g = 0, ops.init_ranks(old.sg.n, cfg)
+        if cfg.checkpoint_dir:
+            latest = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+            if latest is not None:
+                step, arrays, _ = ckpt.load_checkpoint(latest, cfg.config_hash())
+                at_iter, ranks_g = int(step), arrays["ranks"]
+        plan = elastic.plan_shrink(list(old.mesh.devices.flat))
+        if plan is None:
+            raise exc
+        with elastic.publish_shrink("pagerank_result_pull", plan, exc, metrics):
+            new_mesh = rebuild_mesh(plan.devices, old.mesh.axis_names[0])
+            new = _ShardedExec(graph, cfg, new_mesh, strategy, metrics)
+            rd2 = new.put_ranks(ranks_g)
+        todo = done - at_iter
+        if todo > 0:
+            seg_cfg = dataclasses.replace(
+                cfg, iterations=todo, checkpoint_every=0, checkpoint_dir=None
+            )
+            rd2, _, _ = new.invoke(new.make_runner(seg_cfg), rd2)
+        exec_box["exec"] = new
+        # same site: chaos's device_lost is gated on the health registry,
+        # so the acknowledged loss cannot re-fire here
+        with obs.span("pagerank.result_pull_rebuilt"):
+            return rx.device_get(
+                rd2, site="pagerank_result_pull", metrics=metrics,
+                checkpoint_dir=cfg.checkpoint_dir,
+            )
+
     with obs.span("pagerank.result_pull"):
         ranks_np = rx.device_get(
             ranks_dev, site="pagerank_result_pull", metrics=metrics,
             checkpoint_dir=cfg.checkpoint_dir,
+            fallbacks=[(None, pull_rebuild)],
         )
+    exec_ = exec_box["exec"]  # a rebuild rung may have swapped it
     return PageRankResult(
         ranks=ranks_np[exec_.sg.node_map], iterations=done,
         l1_delta=last_delta, metrics=metrics,

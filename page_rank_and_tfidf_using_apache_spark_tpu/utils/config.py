@@ -34,6 +34,9 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         "GRAFT_CKPT_KEEP",  # checkpoint retention count
         "GRAFT_SEMANTIC_BUDGET_S",  # tools/ci.sh wall-clock budget for the
         # semantic lint tier (read in bash, declared here all the same)
+        "GRAFT_TRACE_DIFF_THRESHOLD",  # tools/ci.sh per-phase wall-time
+        # regression threshold for the trace-diff gate over the two newest
+        # committed BENCH rounds (read in bash; default 0.35)
         "GRAFT_LOG_LEVEL",  # stderr log level (utils/metrics.py; default INFO)
         "GRAFT_TRACE_DIR",  # obs/ run-telemetry output dir: traced runs write
         # <name>.<pid>.trace.jsonl + .manifest.json here (unset = no trace)
@@ -142,8 +145,20 @@ class PageRankConfig:
     # of uniform (BASELINE.json:10). None => standard PageRank.
     personalize: tuple[int, ...] | None = None
     # Sparse matvec implementation: "segment" (sorted segment_sum — default),
-    # "bcoo" (jax.experimental.sparse), or "pallas" (hand-written TPU kernel).
+    # "bcoo" (jax.experimental.sparse), "cumsum"/"cumsum_mxu" (scatter-free
+    # prefix-sum diff), "hybrid" (degree-aware dense MXU head + segment
+    # tail), "sort_shuffle" (fixed-width dst buckets, pure reshape→reduce),
+    # or "pallas" (hand-written TPU prefix-sum kernel).
     spmv_impl: str = "segment"
+    # spmv_impl="hybrid" layout knobs: the head is the smallest top-k
+    # in-degree set covering ~head_coverage of all edges (every member's
+    # in-degree >= the dense row width, which adapts down from
+    # head_row_width on small graphs).
+    head_coverage: float = 0.5
+    head_row_width: int = 128
+    # spmv_impl="sort_shuffle": bucket width each destination's edge run is
+    # padded to (the factor the dynamic reduction shrinks by).
+    shuffle_bucket_width: int = 8
     dtype: str = "float32"
     # Checkpoint every k iterations (0 = off) into checkpoint_dir.
     checkpoint_every: int = 0
@@ -164,9 +179,19 @@ class PageRankConfig:
             # the canonical Spark example has no restart vector; silently
             # ignoring --personalize would be worse than refusing
             raise ValueError("spark_exact cannot be personalized")
-        if self.spmv_impl not in ("segment", "bcoo", "cumsum", "cumsum_mxu", "pallas"):
+        if self.spmv_impl not in ("segment", "bcoo", "cumsum", "cumsum_mxu",
+                                  "hybrid", "sort_shuffle", "pallas"):
             raise ValueError(f"unknown spmv_impl {self.spmv_impl!r}")
-        if self.spark_exact and self.spmv_impl in ("cumsum", "cumsum_mxu", "pallas"):
+        if not 0.0 < self.head_coverage <= 1.0:
+            raise ValueError(
+                f"head_coverage must be in (0, 1], got {self.head_coverage}"
+            )
+        if self.head_row_width < 8 or self.shuffle_bucket_width < 2:
+            raise ValueError(
+                "head_row_width must be >= 8 and shuffle_bucket_width >= 2, "
+                f"got {self.head_row_width}/{self.shuffle_bucket_width}"
+            )
+        if self.spark_exact and self.spmv_impl not in ("segment", "bcoo"):
             # spark_exact's presence test counts unit contributions through
             # the SpMV; a float32 prefix sum stops resolving +1.0 past 2^24
             # accumulated mass, silently zeroing live nodes at large-graph

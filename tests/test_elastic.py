@@ -261,6 +261,50 @@ def test_pagerank_sharded_shrinks_4to2_nodes_balanced(tmp_path):
     assert [p["devices"] for p in parts] == [4, 2]  # repartitioned once
 
 
+@pytest.mark.parametrize("strategy", ["edges", "hybrid"])
+def test_pagerank_sharded_device_loss_at_result_pull(tmp_path, strategy):
+    """Carried-forward hardening (a), ISSUE 7: a device loss FIRST
+    surfacing at the sharded result-pull site (every segment already
+    committed, nothing left to dispatch) used to exhaust the ladder — it
+    must now walk the elastic rung: salvage the newest checkpoint,
+    rebuild the mesh over the survivor, re-run only the uncommitted
+    iterations there, and pull from the rebuilt mesh."""
+    g = synthetic_powerlaw(700, 2800, seed=11)
+    cfg = PageRankConfig(iterations=8, checkpoint_every=4,
+                         checkpoint_dir=str(tmp_path / "ck"), **GRAPH_KW)
+    base = run_pagerank(g, PageRankConfig(iterations=8, **GRAPH_KW))
+    m = MetricsRecorder()
+    with chaos.inject("pagerank_result_pull:device_lost@dev:1"):
+        res = run_pagerank_sharded(g, cfg, n_devices=2, metrics=m,
+                                   strategy=strategy)
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+    assert res.iterations == 8
+    degraded = [r for r in m.records if r.get("event") == "degraded"]
+    assert [d["ladder"] for d in degraded] == ["single_device"]
+    assert degraded[0]["site"] == "pagerank_result_pull"
+    assert (degraded[0]["devices_old"], degraded[0]["devices_new"]) == (2, 1)
+    # the salvage repartitioned once onto the survivor
+    parts = [r for r in m.records if r.get("event") == "partition"]
+    assert [p["devices"] for p in parts] == [2, 1]
+
+
+def test_pagerank_result_pull_loss_without_checkpoint_reruns(tmp_path):
+    """No checkpoint_dir: the pull rung restarts the fixpoint from init
+    on the shrunk mesh — slower, but still converging to the
+    uninterrupted answer instead of exhausting."""
+    g = synthetic_powerlaw(300, 1200, seed=6)
+    base = run_pagerank(g, PageRankConfig(iterations=6, **GRAPH_KW))
+    m = MetricsRecorder()
+    with chaos.inject("pagerank_result_pull:device_lost@dev:1"):
+        res = run_pagerank_sharded(
+            g, PageRankConfig(iterations=6, **GRAPH_KW), n_devices=2,
+            metrics=m,
+        )
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+    assert [d["ladder"] for d in m.records
+            if d.get("event") == "degraded"] == ["single_device"]
+
+
 def test_pagerank_sharded_elastic_disabled_exhausts(tmp_path, monkeypatch):
     monkeypatch.setenv("GRAFT_ELASTIC", "0")
     g = synthetic_powerlaw(400, 1600, seed=3)

@@ -33,7 +33,8 @@ def single_chip_ranks(graph):
 
 @pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
 @pytest.mark.parametrize(
-    "strategy", ["edges", "nodes", "nodes_balanced", "src", "src_ring"])
+    "strategy",
+    ["edges", "nodes", "nodes_balanced", "src", "src_ring", "hybrid"])
 def test_chip_count_invariance(graph, single_chip_ranks, n_devices, strategy):
     res = run_pagerank_sharded(graph, CFG, n_devices=n_devices, strategy=strategy)
     assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9
@@ -188,17 +189,19 @@ def test_ring_reduce_scatter_matches_psum_scatter():
             got.ravel(), x.sum(axis=0), atol=1e-12)
 
 
-def test_auto_select_strategy(graph):
+def test_auto_select_strategy(graph, single_chip_ranks):
     from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
         auto_select_strategy,
     )
 
-    # tiny graph, generous budget -> replicated 'edges'
-    assert auto_select_strategy(graph, 8) == "edges"
+    # hub-heavy powerlaw graph, generous budget -> degree-aware 'hybrid'
+    # (the no-head and starved-budget pins live in test_hybrid_spmv.py)
+    assert auto_select_strategy(graph, 8) == "hybrid"
     # starved budget -> memory-scaling layout
     assert auto_select_strategy(graph, 8, hbm_bytes=10_000) == "nodes_balanced"
     res = run_pagerank_sharded(graph, CFG, n_devices=4, strategy="auto")
     assert any(r.get("event") == "auto_strategy" for r in res.metrics.records)
+    assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9
 
 
 def test_spark_exact_sharded_raises(graph):

@@ -44,6 +44,34 @@ if [ "$dt" -gt "${GRAFT_COST_BUDGET_S:-10}" ]; then
     exit 1
 fi
 
+echo "== trace-diff gate (per-phase regression across committed rounds) =="
+# Compare the two newest committed BENCH rounds: a per-phase wall-time
+# regression past GRAFT_TRACE_DIFF_THRESHOLD (default 35%) in the
+# committed trajectory fails CI — the round that paid it must explain
+# itself before the next one lands on top.  rc=2 (a round without
+# extra.breakdown, e.g. pre-PR-4 artifacts) skips the gate with a notice:
+# it arms itself the first time two breakdown-carrying rounds exist.
+# `|| true`: zero matching rounds must take the skip branch below, not
+# kill the script via set -e/pipefail; sort -V keeps r100 after r99
+rounds=$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -2 || true)
+if [ "$(echo "$rounds" | grep -c .)" -eq 2 ]; then
+    prev=$(echo "$rounds" | head -1)
+    cur=$(echo "$rounds" | tail -1)
+    set +e
+    python tools/trace_diff.py "$prev" "$cur" \
+        --threshold "${GRAFT_TRACE_DIFF_THRESHOLD:-0.35}"
+    diff_rc=$?
+    set -e
+    if [ "$diff_rc" -eq 1 ]; then
+        echo "FAIL: $cur regressed a phase past ${GRAFT_TRACE_DIFF_THRESHOLD:-0.35} vs $prev" >&2
+        exit 1
+    elif [ "$diff_rc" -eq 2 ]; then
+        echo "trace-diff gate: skipped ($prev/$cur carry no per-phase breakdown)"
+    fi
+else
+    echo "trace-diff gate: skipped (fewer than two committed rounds)"
+fi
+
 echo "== traced-run smoke (obs + trace_report) =="
 # A tiny streaming TF-IDF run under GRAFT_TRACE_DIR must leave a JSONL
 # trace + manifest that tools/trace_report.py turns into a per-phase
