@@ -341,6 +341,149 @@ def _measure_tfidf_traced(obs) -> dict:
             "n_tokens": tok_total, "nnz": out.nnz}
 
 
+def measure_serve() -> dict:
+    """Served-QPS bench (ISSUE 8): build a servable index from the bench
+    corpus, then race the warm batched serving path against the naive
+    per-request (batch=1, cold) loop — the status-quo cost of scoring
+    without a long-lived server, where every query pays a fresh compile.
+
+    Reports p50/p99 latency and QPS at ≥2 fixed micro-batch sizes, cache
+    hit counts, and the warm/naive speedup.  Runs traced: every request is
+    a ``serve_request`` event, every batch a ``serve.batch`` span, so
+    ``trace_report`` shows queue-wait vs pad vs dispatch vs pull."""
+    from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+    with obs.run("serve"):
+        return _measure_serve_traced(obs)
+
+
+def _measure_serve_traced(obs) -> dict:
+    import tempfile as tf
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+
+    with obs.span("bench.corpus"):
+        docs = _corpus()
+    cfg = TfidfConfig(vocab_bits=18)
+    idx_dir = tf.mkdtemp(prefix="bench_serve_idx_")
+    try:
+        return _measure_serve_on_index(obs, docs, cfg, idx_dir)
+    finally:
+        import shutil
+
+        shutil.rmtree(idx_dir, ignore_errors=True)
+
+
+def _measure_serve_on_index(obs, docs, cfg, idx_dir: str) -> dict:
+    import jax
+
+    from page_rank_and_tfidf_using_apache_spark_tpu import serving
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as tops
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import percentile
+
+    with obs.span("bench.index_build"):
+        out = run_tfidf(docs, cfg)
+        serving.save_index(idx_dir, out, cfg)
+        index = serving.load_index(idx_dir)
+    log(f"[serve] index v{index.version}: {index.n_docs} docs, "
+        f"{index.nnz} nnz")
+
+    # Query stream at the bench's Zipf vocabulary: mostly unique, a hot
+    # head repeated so the LRU has something to do (production query logs
+    # are Zipf too).
+    rng = np.random.default_rng(SEED)
+    n_queries = int(os.environ.get("BENCH_SERVE_QUERIES", 256))
+    hot = [[f"w{rng.zipf(1.3) % 50_000}" for _ in range(3)] for _ in range(8)]
+    queries = []
+    for _ in range(n_queries):
+        if rng.random() < 0.25:
+            queries.append(hot[int(rng.integers(len(hot)))])
+        else:
+            queries.append([f"w{rng.zipf(1.3) % 50_000}"
+                            for _ in range(int(rng.integers(2, 5)))])
+
+    # --- naive per-request (batch=1, cold) loop: every request pays its
+    # own compile, exactly what scoring costs without a warm server ---
+    import jax.numpy as jnp
+
+    res_dev = tops.TfidfResult(
+        doc=jnp.asarray(np.ascontiguousarray(index.doc)),
+        term=jnp.asarray(np.ascontiguousarray(index.term)),
+        weight=jnp.asarray(np.ascontiguousarray(index.weight)),
+        n_pairs=jnp.asarray(index.nnz),
+        valid=jnp.ones(index.nnz, index.weight.dtype),
+        idf=jnp.asarray(np.ascontiguousarray(index.idf)),
+        df=jnp.asarray(np.ascontiguousarray(index.df)),
+    )
+    k = 10
+    n_naive = int(os.environ.get("BENCH_SERVE_NAIVE", 8))
+    helper = serving.TfidfServer(index, serving.ServeConfig(top_k=k))
+    t0 = time.perf_counter()
+    with obs.span("bench.serve_naive", requests=n_naive):
+        for terms in queries[:n_naive]:
+            qt, qw = helper.make_query(terms)
+            qvec = np.zeros(index.vocab_size, index.weight.dtype)
+            np.add.at(qvec, qt, qw)
+            # a FRESH jit wrapper per request defeats the executable
+            # cache: this is the per-request cold cost a process-per-query
+            # (or CLI-per-query) deployment pays
+            cold = jax.jit(
+                lambda r, q: tops.score_query(r, q, n_docs=index.n_docs, k=k)
+            )
+            scores, idxs = cold(res_dev, jnp.asarray(qvec))
+            # the per-request round-trip IS the thing being measured here:
+            # this loop exists to price the no-server status quo
+            np.asarray(scores), np.asarray(idxs)  # graftlint: disable=host-sync-in-loop
+    naive_secs = max(time.perf_counter() - t0, 1e-9)
+    naive_qps = n_naive / naive_secs
+    log(f"[serve] naive cold loop: {n_naive} req in {naive_secs:.2f}s "
+        f"-> {naive_qps:.2f} qps")
+
+    # --- warm batched path at fixed micro-batch sizes ---
+    served: dict = {}
+    for max_batch in (4, 8, 16):
+        scfg = serving.ServeConfig(top_k=k, max_batch=max_batch,
+                                   queue_depth=max(64, 2 * max_batch))
+        with serving.TfidfServer(index, scfg) as srv:
+            with obs.span("bench.serve_warm", batch=max_batch):
+                # warm with THROWAWAY queries disjoint from the measured
+                # stream: the timed pass must earn its cache hits from
+                # genuine repeats, not from a warmup that pre-scored its
+                # own prefix
+                pendings = [srv.submit([f"warmonly{i}"])
+                            for i in range(2 * max_batch)]
+                for p in pendings:
+                    p.result(60.0)  # warm pass: absorb any residual lazies
+                t0 = time.perf_counter()
+                pendings = [srv.submit(q) for q in queries]
+                lats = []
+                for p in pendings:
+                    p.result(120.0)
+                    lats.append(p.latency_s or 0.0)
+                secs = max(time.perf_counter() - t0, 1e-9)
+            stats = srv.stats()
+        lats.sort()
+        served[f"b{max_batch}"] = {
+            "qps": round(n_queries / secs, 2),
+            "p50_ms": round(percentile(lats, 0.50) * 1e3, 3),
+            "p99_ms": round(percentile(lats, 0.99) * 1e3, 3),
+            "cache_hits": stats["cache_hits"],
+            "batches": stats["batches"],
+        }
+        log(f"[serve] b{max_batch}: {served[f'b{max_batch}']}")
+    best_qps = max(v["qps"] for v in served.values())
+    return {
+        "served_qps": served,
+        "naive_qps": round(naive_qps, 3),
+        "naive_requests": n_naive,
+        "requests": n_queries,
+        "speedup_vs_naive": round(best_qps / naive_qps, 2),
+        "index_nnz": index.nnz,
+        "backend": jax.default_backend(),
+    }
+
+
 def measure_tfidf_sharded() -> dict:
     """Sharded (multi-device) ingest throughput — the ROADMAP's
     ``tfidf_sharded_tokens_per_sec``, null in every round before this
@@ -639,7 +782,14 @@ def _main(graph_cache: str) -> int:
     else:
         trace_dir = tempfile.mkdtemp(prefix="bench_trace_")
     child_env["GRAFT_TRACE_DIR"] = trace_dir
-    log(f"trace artifacts: {trace_dir}")
+    # Cross-process span propagation (ROADMAP hardening (c)): the parent
+    # exports ONE trace id for the whole round; every child run adopts it
+    # in its run_start event + manifest, so
+    # `tools/trace_report.py <trace_dir>` stitches the round back into a
+    # single tree without pid archaeology.
+    trace_parent = f"bench-{os.getpid()}-{int(time.time())}"
+    child_env["GRAFT_TRACE_PARENT"] = trace_parent
+    log(f"trace artifacts: {trace_dir} (trace parent {trace_parent})")
 
     # --- CPU anchor: scipy CSR power iteration (same math, float32) ---
     import scipy.sparse as sp
@@ -700,6 +850,7 @@ def _main(graph_cache: str) -> int:
     # --- TF-IDF throughput (configs 2 and 5) ---
     tfidf_out = None
     sharded_out = None
+    serve_out = None
     tfidf_record: dict = {}
     if not os.environ.get("BENCH_SKIP_TFIDF"):
         import shutil
@@ -758,6 +909,9 @@ def _main(graph_cache: str) -> int:
                         flags + " --xla_force_host_platform_device_count=4"
                     ).strip()
             sharded_out = _run_child("tfidf-sharded", TFIDF_TIMEOUT_S, sh_env)
+            # Served-QPS (ISSUE 8): warm batched query path vs the naive
+            # per-request cold loop, p50/p99 at fixed batch sizes.
+            serve_out = _run_child("serve", TFIDF_TIMEOUT_S, child_env)
         finally:
             os.unlink(corpus_cache)
             shutil.rmtree(ck_dir, ignore_errors=True)
@@ -774,6 +928,14 @@ def _main(graph_cache: str) -> int:
                    # or "env" (explicit GRAFT_SYNC_DEADLINE_S)
                    "sync_deadline_s": sync_deadline_s,
                    "sync_deadline_source": sync_deadline_source}
+    extra["trace_parent"] = trace_parent
+    # Always present so rounds are comparable: null = the serve child did
+    # not produce a number this round.
+    extra["served_qps"] = None
+    if serve_out and serve_out.get("served_qps"):
+        extra["served_qps"] = serve_out["served_qps"]
+        extra["serve_naive_qps"] = serve_out.get("naive_qps")
+        extra["serve_speedup_vs_naive"] = serve_out.get("speedup_vs_naive")
     # Always present so rounds are comparable: null = the sharded child
     # did not produce a number this round.
     extra["tfidf_sharded_tokens_per_sec"] = None
@@ -845,6 +1007,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1] == "--tfidf-sharded":
         print(json.dumps(measure_tfidf_sharded()))
+        sys.exit(0)
+    if len(sys.argv) == 2 and sys.argv[1] == "--serve":
+        print(json.dumps(measure_serve()))
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1].startswith("--impl="):
         print(json.dumps(measure_impl(sys.argv[1].split("=", 1)[1])))

@@ -572,7 +572,7 @@ def check_dce_timed(ctx: FileContext) -> Iterator[Hit]:
 # executor (retry/backoff, sync deadlines, the CPU degradation ladder, and
 # ResilienceExhausted-with-checkpoint).  resilience/ itself is exempt — it
 # is where the raw calls legitimately live.
-_GUARDED_TREE_DIRS = frozenset({"models", "parallel", "io"})
+_GUARDED_TREE_DIRS = frozenset({"models", "parallel", "io", "serving"})
 _RAW_SYNC_CALLS = frozenset({"jax.device_get", "jax.block_until_ready"})
 _ASARRAY_CALLS = frozenset(
     {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
@@ -609,7 +609,8 @@ def _device_bound_names(fn: FuncNode | None, ctx: FileContext) -> set[str]:
 @rule(
     "unguarded-host-sync",
     "raw jax.device_get / .block_until_ready() / np.asarray(device value) "
-    "in models/, parallel/ or io/ — host syncs there must route through "
+    "in models/, parallel/, io/ or serving/ — host syncs there must route "
+    "through "
     "resilience.executor so retries, sync deadlines and the degradation "
     "ladder apply (ratchet stays at zero: migrate, don't baseline)",
 )
@@ -699,7 +700,8 @@ def _inside_span(node: ast.AST, ctx: FileContext) -> bool:
 @rule(
     "untraced-guarded-site",
     "run_guarded / guarded device_get / block_until_ready call site in "
-    "models/, parallel/ or io/ outside an active obs.span — the resilience "
+    "models/, parallel/, io/ or serving/ outside an active obs.span — the "
+    "resilience "
     "ladder's retry/watchdog/degrade events would land in the trace with "
     "no phase to attribute them to",
 )

@@ -1,0 +1,140 @@
+"""Serving CLI — the long-lived query process over a built index
+(ISSUE 8).
+
+Usage::
+
+    # build an index once (see also: cli.tfidf --save-index)
+    python -m page_rank_and_tfidf_using_apache_spark_tpu.cli.tfidf \
+        corpus.txt --lines --save-index /data/index
+
+    # serve queries against it (one query per line, space-separated terms)
+    python -m page_rank_and_tfidf_using_apache_spark_tpu.cli.serve \
+        /data/index --queries queries.txt --top-k 10
+
+With ``--queries -`` (the default) queries stream from stdin, so the
+process can sit behind a pipe indefinitely — the artifact is mapped once,
+the compiled batch runners stay warm, and every request rides the padded
+micro-batch path.  Output: one ``<query#>\t<doc>\t<score>`` line per hit;
+a summary JSON (stats + latency percentiles) lands on stderr at exit.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+    ServeConfig,
+    TfidfServer,
+    load_index,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="serve",
+        description="Serve top-k TF-IDF queries from a built index artifact.",
+    )
+    p.add_argument("index", help="index directory (serving.artifact layout)")
+    p.add_argument("--version", type=int, default=None,
+                   help="serve this index version (default: LATEST)")
+    p.add_argument("--queries", default="-",
+                   help="file of queries, one per line ('-' = stdin)")
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--max-batch", type=int, default=8,
+                   help="micro-batch cap (padded shapes are powers of two)")
+    p.add_argument("--max-query-terms", type=int, default=16)
+    p.add_argument("--cache-size", type=int, default=1024,
+                   help="hot-query LRU entries (0 disables)")
+    p.add_argument("--rank-alpha", type=float, default=0.0,
+                   help="blend the index's PageRank prior into scores "
+                        "(score + alpha * rank; needs an index built with "
+                        "ranks)")
+    p.add_argument("--no-mmap", action="store_true",
+                   help="copy the index into RAM instead of mapping it")
+    p.add_argument("--trace-dir", default=None,
+                   help="obs run-telemetry dir (default: $GRAFT_TRACE_DIR)")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    with obs.run("serve", trace_dir=args.trace_dir):
+        return _main(args)
+
+
+def _main(args) -> int:
+    index = load_index(args.index, version=args.version,
+                       mmap=not args.no_mmap)
+    cfg = ServeConfig(
+        top_k=args.top_k,
+        max_batch=args.max_batch,
+        max_query_terms=args.max_query_terms,
+        cache_size=args.cache_size,
+        rank_alpha=args.rank_alpha,
+    )
+    source = sys.stdin if args.queries == "-" else open(args.queries)
+    lat: list[float] = []
+    try:
+        # stdin is request/response: a client writing one query and
+        # waiting for output must get its answer before this process
+        # reads the next line (the micro-batcher still coalesces queries
+        # arriving within one flush window via other submitters).  A
+        # query FILE is throughput mode: keep a full batch in flight.
+        interactive = source is sys.stdin
+        with TfidfServer(index, cfg) as srv:
+            pending = []
+            for qid, line in enumerate(source):
+                terms = line.split()
+                if not terms:
+                    continue
+                pending.append((qid, srv.submit(terms)))
+                if interactive:
+                    while pending:
+                        _drain_one(pending, lat)
+                else:
+                    # drain in submit order: eagerly when already
+                    # resolved, blocking only to bound the window
+                    while pending and pending[0][1].done:
+                        _drain_one(pending, lat)
+                    while len(pending) > cfg.max_batch:
+                        _drain_one(pending, lat)
+            while pending:
+                _drain_one(pending, lat)
+            stats = srv.stats()
+    finally:
+        if source is not sys.stdin:
+            source.close()
+    stats["p50_ms"], stats["p99_ms"] = _percentiles_ms(lat)
+    print(json.dumps(stats), file=sys.stderr)
+    return 0
+
+
+def _drain_one(pending: list, lat: list[float]) -> None:
+    qid, fut = pending.pop(0)
+    scores, docs = fut.result()
+    lat.append(fut.latency_s or 0.0)
+    for s, d in zip(scores, docs):
+        if float(s) > 0:
+            print(f"{qid}\t{int(d)}\t{float(s):.10g}")
+    # stdout is block-buffered behind a pipe; a request/response client
+    # must see its answer now, not at process exit
+    sys.stdout.flush()
+
+
+def _percentiles_ms(lat: list[float]) -> tuple[float | None, float | None]:
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import (
+        percentile,
+    )
+
+    if not lat:
+        return None, None
+    xs = sorted(lat)
+    return (round(percentile(xs, 0.50) * 1e3, 3),
+            round(percentile(xs, 0.99) * 1e3, 3))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,6 +63,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch", type=int, default=2,
                    help="tokenizer chunks to double-buffer ahead of device "
                         "compute (0 = serial)")
+    p.add_argument("--save-index", default=None, metavar="DIR",
+                   help="serialize the result as the next servable index "
+                        "version under DIR (serving/artifact.py) — the "
+                        "input of `cli.serve`")
+    p.add_argument("--index-ranks", default=None, metavar="NPY",
+                   help="with --save-index: bundle this [n_docs] PageRank "
+                        "prior (.npy) into the artifact")
     p.add_argument("--query", nargs="+", default=None, metavar="TERM",
                    help="score docs against these terms, print top-k")
     p.add_argument("--top-k", type=int, default=10)
@@ -140,6 +147,15 @@ def _main(args) -> int:
             )
         else:
             out = run_tfidf(docs, cfg, metrics=metrics, doc_names=names)
+
+    if args.save_index:
+        import numpy as np
+
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import save_index
+
+        ranks = np.load(args.index_ranks) if args.index_ranks else None
+        path = save_index(args.save_index, out, cfg, ranks=ranks)
+        print(json.dumps({"index": path}), file=sys.stderr)
 
     if args.output:
         with open(args.output, "w") as f:

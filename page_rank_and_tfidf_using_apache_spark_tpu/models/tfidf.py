@@ -113,15 +113,18 @@ def run_tfidf(
 
 
 def grow_chunk_cap(
-    need: int, cap: int, metrics: MetricsRecorder, **context
+    need: int, cap: int, metrics: MetricsRecorder, *, min_bits: int = 10,
+    **context
 ) -> tuple[int, bool]:
-    """Fixed-shape chunk capacity policy, shared by the streaming and
-    sharded ingest paths: power-of-two start, doubling bumps (each bump is a
-    logged recompile — SURVEY.md §7 'fixed shapes under jit').
-    Returns (cap, changed)."""
+    """Fixed-shape capacity policy, shared by the streaming/sharded ingest
+    paths AND the serving micro-batcher: power-of-two start (at least
+    ``2**min_bits`` — the ingest default of 10 keeps token chunks
+    kernel-sized; the serving batcher passes 0 so a batch of 3 pads to 4,
+    not 1024), doubling bumps (each bump is a logged recompile —
+    SURVEY.md §7 'fixed shapes under jit').  Returns (cap, changed)."""
     changed = False
     if cap <= 0:
-        cap = 1 << max(10, int(np.ceil(np.log2(max(need, 1)))))
+        cap = 1 << max(min_bits, int(np.ceil(np.log2(max(need, 1)))))
         changed = True
     while need > cap:
         cap *= 2

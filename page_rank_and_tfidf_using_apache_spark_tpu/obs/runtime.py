@@ -52,6 +52,13 @@ class Run:
         self._sink: JsonlSink | None = None
         self._events0 = 0
         self._finalized = False
+        # Cross-process span propagation (ROADMAP hardening (c)): a parent
+        # process that wants one trace tree over many children exports an
+        # opaque trace id as GRAFT_TRACE_PARENT; every child run adopts it
+        # here — in the run_start event AND the manifest — so
+        # tools/trace_report.py --stitch can reassemble the round's tree
+        # from the artifacts alone, no pid archaeology.
+        self.trace_parent = os.environ.get("GRAFT_TRACE_PARENT") or None
         if trace_dir:
             os.makedirs(trace_dir, exist_ok=True)
             stem = f"{name}.{os.getpid()}"
@@ -62,11 +69,18 @@ class Run:
             # to the bus, so a failed construction can never leak an
             # attached orphan sink collecting a run that never started
             self._manifest_doc = mf.write_manifest(
-                self.manifest_path, name, self.trace_path
+                self.manifest_path, name, self.trace_path,
+                extra=(
+                    {"trace_parent": self.trace_parent}
+                    if self.trace_parent else None
+                ),
             )
             self._sink = JsonlSink(self.trace_path)
             _BUS.attach(self._sink)
-        start = _BUS.publish("run_start", name=name, run_pid=os.getpid())
+        start = _BUS.publish(
+            "run_start", name=name, run_pid=os.getpid(),
+            **({"trace_parent": self.trace_parent} if self.trace_parent else {}),
+        )
         self._events0 = start["seq"]
 
     # ------------------------------------------------------------- metrics

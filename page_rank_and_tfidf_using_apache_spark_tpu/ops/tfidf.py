@@ -270,3 +270,51 @@ def score_query(
     per_pair = result.weight * query_weights[result.term] * result.valid
     scores = jax.ops.segment_sum(per_pair, result.doc, num_segments=n_docs)
     return jax.lax.top_k(scores, k)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_docs", "vocab", "k", "use_prior"))
+def score_query_batch(
+    doc: jax.Array,  # int32 [nnz] postings (device-resident across calls)
+    term: jax.Array,  # int32 [nnz]
+    weight: jax.Array,  # f[nnz]
+    valid: jax.Array,  # f[nnz]
+    q_term: jax.Array,  # int32 [B, Q] hashed query term ids (padded)
+    q_weight: jax.Array,  # f[B, Q] per-term query weights
+    q_valid: jax.Array,  # f[B, Q] 1.0 for real query slots
+    doc_prior: jax.Array,  # f[n_docs] additive prior (e.g. scaled PageRank)
+    *,
+    n_docs: int,
+    vocab: int,
+    k: int,
+    use_prior: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """The warm serving path's batched A11 scorer (ISSUE 8): one compiled
+    program scores a padded micro-batch of sparse queries against the
+    device-resident postings and returns per-query top-k — the full
+    ``[B, n_docs]`` score matrix never crosses device→host.
+
+    Queries arrive *sparse* ([B, Q] term ids + weights, Q fixed) so the
+    per-request H2D transfer is bytes, not a vocab-sized vector; the dense
+    per-query lookup table is scattered on device.  Padding slots carry
+    ``q_valid`` 0 and term id 0, scattering nothing.  Per query the math is
+    exactly :func:`score_query`'s (same multiply order, same segment_sum),
+    so a served result is bit-equal to the one-shot path — pinned by
+    tests/test_serving.py.  ``use_prior`` (static) fuses an additive
+    per-document prior — the PageRank ranks riding in the serving artifact
+    — into the score before top-k.
+    """
+    b = q_term.shape[0]
+    qdense = jnp.zeros((b, vocab), weight.dtype)
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+    qdense = qdense.at[rows, q_term].add(q_weight * q_valid)
+
+    def one(qrow):
+        per_pair = weight * qrow[term] * valid
+        scores = jax.ops.segment_sum(per_pair, doc, num_segments=n_docs)
+        if use_prior:
+            scores = scores + doc_prior
+        return scores
+
+    scores = jax.vmap(one)(qdense)
+    return jax.lax.top_k(scores, k)

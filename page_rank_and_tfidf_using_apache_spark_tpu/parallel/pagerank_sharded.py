@@ -785,38 +785,75 @@ def _make_elastic_rebuild(graph: Graph, cfg: PageRankConfig, strategy: str,
         # (1) salvage state at the last committed iteration: live buffers
         # first (survivor shards are usually intact), else the newest
         # checkpoint — both carry the logical [n] ranks, so they read the
-        # same across mesh shapes.
-        try:
-            ranks_g, at_iter = old.extract_np(ranks_dev), done
-        except Exception:
-            latest = (ckpt.latest_checkpoint(cfg.checkpoint_dir)
-                      if cfg.checkpoint_dir else None)
-            if latest is None:
-                raise exc
-            step, arrays, _ = ckpt.load_checkpoint(latest, cfg.config_hash())
-            ranks_g, at_iter = arrays["ranks"], int(step)
+        # same across mesh shapes.  A FURTHER device loss surfacing inside
+        # the salvage pull itself is acknowledged and the pull retried —
+        # each lap must mark a NEW device, so a genuinely dead pull falls
+        # through to the checkpoint after at most one lap per lost device.
+        while True:
+            try:
+                ranks_g, at_iter = old.extract_np(ranks_dev), done
+                break
+            except Exception as exc_s:
+                lost_s = elastic.unwrap_device_loss(exc_s)
+                idx_s = (elastic.device_index(lost_s)
+                         if lost_s is not None else None)
+                if idx_s is not None and elastic.health().mark_lost(idx_s):
+                    exc = lost_s  # the newest loss is what the shrink blames
+                    continue
+                latest = (ckpt.latest_checkpoint(cfg.checkpoint_dir)
+                          if cfg.checkpoint_dir else None)
+                if latest is None:
+                    raise exc
+                step, arrays, _ = ckpt.load_checkpoint(
+                    latest, cfg.config_hash()
+                )
+                ranks_g, at_iter = arrays["ranks"], int(step)
+                break
         if cfg.checkpoint_dir:
             ckpt.save_checkpoint(
                 cfg.checkpoint_dir, at_iter, {"ranks": ranks_g},
                 cfg.config_hash(), extra={"devices": old.d},
             )
-        # (2) plan + build the surviving mesh
-        plan = elastic.plan_shrink(list(old.mesh.devices.flat))
-        if plan is None:
-            raise exc
-        with elastic.publish_shrink("pagerank_step", plan, exc, metrics):
-            # keep the dying mesh's axis name: a caller-provided mesh may
-            # not be named NODES_AXIS, and the runner/shardings are built
-            # from whatever the mesh declares
-            new_mesh = rebuild_mesh(plan.devices, old.mesh.axis_names[0])
-            # (3) repartition for the survivors
-            new = _ShardedExec(graph, cfg, new_mesh, strategy, metrics)
-            rd2 = new.put_ranks(ranks_g)
-        # (4) resume: rerun this segment's span from the salvage point —
-        # committed iterations (< at_iter) are never recomputed
+        # (2)-(4) shrink / rebuild / rerun — as a LOOP, because a second
+        # device can die while the rerun itself is in flight (the elastic
+        # gap, ISSUE 8): the rerun runs as one chaos-hooked attempt with
+        # no exhaustion of its own, and a further loss re-enters this
+        # ladder — re-plan from the already-shrunk mesh — instead of
+        # surfacing as ResilienceExhausted.  Committed iterations
+        # (< at_iter) are never recomputed on any lap.
+        devices = list(old.mesh.devices.flat)
+        axis = old.mesh.axis_names[0]
         todo2 = done - at_iter + seg_cfg.iterations
         seg_cfg2 = dataclasses.replace(seg_cfg, iterations=todo2)
-        rd2, iters, delta = new.invoke(new.make_runner(seg_cfg2), rd2)
+        while True:
+            plan = elastic.plan_shrink(devices)
+            if plan is None:
+                raise exc
+            with elastic.publish_shrink("pagerank_step", plan, exc, metrics):
+                # keep the dying mesh's axis name: a caller-provided mesh
+                # may not be named NODES_AXIS, and the runner/shardings
+                # are built from whatever the mesh declares
+                new_mesh = rebuild_mesh(plan.devices, axis)
+                # repartition for the survivors
+                new = _ShardedExec(graph, cfg, new_mesh, strategy, metrics)
+                rd2 = new.put_ranks(ranks_g)
+            try:
+                rd2, iters, delta = rx.attempt_once(
+                    lambda n=new, r=rd2, c=seg_cfg2: n.invoke(
+                        n.make_runner(c), r
+                    ),
+                    site="pagerank_elastic_rerun",
+                )
+                break
+            except Exception as exc2:  # noqa: BLE001 — re-entry filter below
+                lost = elastic.unwrap_device_loss(exc2)
+                if lost is None:
+                    raise
+                idx2 = elastic.device_index(lost)
+                if idx2 is not None:
+                    elastic.health().mark_lost(idx2)
+                exc = lost
+                devices = list(new_mesh.devices.flat)
         exec_box["exec"] = new
         effective = at_iter + int(iters) - done
         return driver.ElasticResult(
@@ -906,19 +943,42 @@ def run_pagerank_sharded(
             if latest is not None:
                 step, arrays, _ = ckpt.load_checkpoint(latest, cfg.config_hash())
                 at_iter, ranks_g = int(step), arrays["ranks"]
-        plan = elastic.plan_shrink(list(old.mesh.devices.flat))
-        if plan is None:
-            raise exc
-        with elastic.publish_shrink("pagerank_result_pull", plan, exc, metrics):
-            new_mesh = rebuild_mesh(plan.devices, old.mesh.axis_names[0])
-            new = _ShardedExec(graph, cfg, new_mesh, strategy, metrics)
-            rd2 = new.put_ranks(ranks_g)
+        devices = list(old.mesh.devices.flat)
+        axis = old.mesh.axis_names[0]
         todo = done - at_iter
-        if todo > 0:
-            seg_cfg = dataclasses.replace(
-                cfg, iterations=todo, checkpoint_every=0, checkpoint_dir=None
-            )
-            rd2, _, _ = new.invoke(new.make_runner(seg_cfg), rd2)
+        seg_cfg = dataclasses.replace(
+            cfg, iterations=todo, checkpoint_every=0, checkpoint_dir=None
+        )
+        # loop for the same reason as the segment rung: a second loss
+        # during the re-run of the uncommitted span re-enters the ladder
+        # (re-plan from the shrunk mesh) instead of exhausting
+        while True:
+            plan = elastic.plan_shrink(devices)
+            if plan is None:
+                raise exc
+            with elastic.publish_shrink(
+                "pagerank_result_pull", plan, exc, metrics
+            ):
+                new_mesh = rebuild_mesh(plan.devices, axis)
+                new = _ShardedExec(graph, cfg, new_mesh, strategy, metrics)
+                rd2 = new.put_ranks(ranks_g)
+            if todo <= 0:
+                break
+            try:
+                rd2, _, _ = rx.attempt_once(
+                    lambda n=new, r=rd2: n.invoke(n.make_runner(seg_cfg), r),
+                    site="pagerank_elastic_rerun",
+                )
+                break
+            except Exception as exc2:  # noqa: BLE001 — re-entry filter below
+                lost = elastic.unwrap_device_loss(exc2)
+                if lost is None:
+                    raise
+                idx2 = elastic.device_index(lost)
+                if idx2 is not None:
+                    elastic.health().mark_lost(idx2)
+                exc = lost
+                devices = list(new_mesh.devices.flat)
         exec_box["exec"] = new
         # same site: chaos's device_lost is gated on the health registry,
         # so the acknowledged loss cannot re-fire here

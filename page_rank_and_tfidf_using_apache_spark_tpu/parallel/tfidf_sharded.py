@@ -50,6 +50,32 @@ from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig,
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 
 
+def _publish_device_timings(arr, step: int) -> None:
+    """Per-device shard-ready timings for the trace chunk timeline
+    (ROADMAP hardening (d)): fence each device's shard of the tiny
+    ``n_pairs`` vector and record when it became ready, measured from the
+    call.  Shards are waited in device order, so entry ``i`` is an upper
+    bound for a device that finished while an earlier one still ran — the
+    straggler (the max) is exact, which is what load-balance debugging
+    needs.  Best-effort telemetry: any fault here is left for the guarded
+    batched pull that follows.  Runs ONLY under an active traced run —
+    untraced ingest keeps the single batched pull as its only sync (on a
+    tunnel-attached TPU each per-shard fence is a real host round-trip,
+    and with no run the event would be discarded anyway)."""
+    if obs.current_run() is None:
+        return
+    try:
+        t0 = time.perf_counter()
+        secs = []
+        for s in arr.addressable_shards:
+            s.data.block_until_ready()  # graftlint: disable=unguarded-host-sync,host-sync-in-loop (per-shard fence for telemetry only; the guarded batched pull right after owns retry/deadline/degradation)
+            secs.append(round(time.perf_counter() - t0, 6))
+        obs.emit("device_timing", site="tfidf_super_chunk", step=step,
+                 devices=len(secs), secs=secs)
+    except Exception:  # noqa: BLE001 — never let telemetry kill ingest
+        pass
+
+
 def make_sharded_counts_kernel(mesh: Mesh, vocab: int):
     """Compile: [D, cap] tokens → per-device counts + globally-psum'd DF."""
     axis = mesh.axis_names[0]
@@ -184,7 +210,8 @@ def run_tfidf_sharded(
             outs: list[tuple] = []
             df_sum = None
             with obs.span("tfidf.reslice", rows=rows, width=d):
-                for lo in range(0, rows, d):
+                lo = 0
+                while lo < rows:
                     batch = slice(lo, lo + d)
                     b_doc = np.zeros((d, cap), np.int32)
                     b_term = np.zeros((d, cap), np.int32)
@@ -193,21 +220,48 @@ def run_tfidf_sharded(
                     b_doc[:n_rows] = doc_ids[batch]
                     b_term[:n_rows] = term_ids[batch]
                     b_valid[:n_rows] = valid[batch]
-                    (r_doc, r_term, r_cnt, r_np, _rv), r_df = kernel(
-                        jax.device_put(b_doc, esh),
-                        jax.device_put(b_term, esh),
-                        jax.device_put(b_valid, esh),
-                    )
-                    # one batched pull per re-sliced dispatch: the shrunk
-                    # mesh processes the in-flight rows sequentially, so
-                    # each sub-dispatch syncs before the next launches
-                    h = rx.device_get(  # graftlint: disable=host-sync-in-loop (one batched pull per re-sliced dispatch on the rare shrink path)
-                        (r_doc, r_term, r_cnt, r_np, r_df),
-                        site="tfidf_shard_sync", metrics=metrics,
-                        checkpoint_dir=cfg.checkpoint_dir,
-                    )
+                    try:
+                        (r_doc, r_term, r_cnt, r_np, _rv), r_df = kernel(
+                            jax.device_put(b_doc, esh),
+                            jax.device_put(b_term, esh),
+                            jax.device_put(b_valid, esh),
+                        )
+                        # one batched pull per re-sliced dispatch: the
+                        # shrunk mesh processes the in-flight rows
+                        # sequentially, so each sub-dispatch syncs before
+                        # the next launches
+                        h = rx.device_get(  # graftlint: disable=host-sync-in-loop (one batched pull per re-sliced dispatch on the rare shrink path)
+                            (r_doc, r_term, r_cnt, r_np, r_df),
+                            site="tfidf_shard_sync", metrics=metrics,
+                            checkpoint_dir=cfg.checkpoint_dir,
+                        )
+                    except Exception as exc2:  # noqa: BLE001 — re-caught below
+                        # A SECOND device dying inside the shrink-rerun
+                        # (ISSUE 8 elastic gap): re-enter the ladder —
+                        # mark the new loss, plan the next shrink from the
+                        # CURRENT (already-shrunk) mesh, rebuild the
+                        # kernel, and re-dispatch the same rows at the new
+                        # width.  Committed rows (< lo) stay committed.
+                        lost = elastic.unwrap_device_loss(exc2)
+                        if lost is None or not elastic.enabled():
+                            raise
+                        idx2 = elastic.device_index(lost)
+                        if idx2 is not None:
+                            elastic.health().mark_lost(idx2)
+                        plan2 = elastic.plan_shrink(list(mesh.devices.flat))
+                        if plan2 is None:
+                            raise
+                        with elastic.publish_shrink(
+                            "tfidf_shard_sync", plan2, lost, metrics
+                        ):
+                            mesh = rebuild_mesh(plan2.devices, axis)
+                            d = plan2.new_count
+                            esh = NamedSharding(mesh, P(axis, None))
+                            kernel = make_sharded_counts_kernel(mesh, vocab)
+                        continue  # same lo: nothing from this batch committed
                     outs.append(h[:4])
                     df_sum = h[4] if df_sum is None else df_sum + h[4]
+                    lo += n_rows
             return (
                 np.concatenate([o[0] for o in outs]),
                 np.concatenate([o[1] for o in outs]),
@@ -223,6 +277,10 @@ def run_tfidf_sharded(
                 jax.device_put(term_ids, esh),
                 jax.device_put(valid, esh),
             )
+            # per-device shard-ready times onto the bus BEFORE the batched
+            # pull, so the trace's chunk timeline can attribute a slow
+            # super-chunk to the straggling device (hardening (d))
+            _publish_device_timings(c_np, step)
             # One batched device->host pull: a single round-trip per
             # super-chunk instead of a block_until_ready fence plus four
             # separate np.asarray transfers (each paying tunnel RTT).

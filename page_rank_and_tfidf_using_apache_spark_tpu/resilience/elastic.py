@@ -108,6 +108,23 @@ def is_device_loss(exc: BaseException) -> bool:
     return any(m in str(exc) for m in _DEVICE_LOSS_MARKERS)
 
 
+def unwrap_device_loss(exc: BaseException) -> BaseException | None:
+    """The device-loss error carried by ``exc``: the exception itself, or
+    the terminal error inside a :class:`~.executor.ResilienceExhausted`
+    (an inner guarded call with no elastic rung of its own exhausts with
+    the loss as its ``last_error`` — the shrink-rerun re-entry path needs
+    to see through that wrapper).  None when ``exc`` is not a loss."""
+    if is_device_loss(exc):
+        return exc
+    from page_rank_and_tfidf_using_apache_spark_tpu.resilience.executor import (
+        ResilienceExhausted,
+    )
+
+    if isinstance(exc, ResilienceExhausted) and is_device_loss(exc.last_error):
+        return exc.last_error
+    return None
+
+
 def device_index(exc: BaseException) -> int | None:
     """The lost logical device index an error names, or None (whole-backend
     loss / no attribution — plan_shrink then relies on probing)."""

@@ -182,6 +182,21 @@ def _attempt(fn: Callable[[], Any], site: str, policy: RetryPolicy) -> Any:
     return box["result"]
 
 
+def attempt_once(
+    fn: Callable[[], Any],
+    *,
+    site: str,
+    policy: RetryPolicy | None = None,
+) -> Any:
+    """ONE chaos-hooked, watchdog-deadlined attempt with no retry loop, no
+    rungs and no ``exhausted`` emission — for callers that own their
+    recovery (the elastic shrink-*rerun*, which on a further device loss
+    must re-enter its own ladder rather than have this layer declare
+    exhaustion).  Faults propagate raw; ``fn`` must be re-invocable."""
+    policy = policy or RetryPolicy.from_env()
+    return _attempt(fn, site, policy)
+
+
 def run_guarded(
     fn: Callable[[], Any],
     *,

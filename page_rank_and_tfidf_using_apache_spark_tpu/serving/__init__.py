@@ -1,0 +1,43 @@
+"""serving/ — the online query path (ISSUE 8).
+
+Everything before this package was batch: build ranks, build TF-IDF, exit.
+This package turns ``ops.tfidf.score_query`` into a real serving stack:
+
+- :mod:`serving.artifact` — a versioned, mmap-loadable index (postings +
+  IDF/DF tables + optional PageRank prior) written through the checkpoint
+  machinery's array-directory format, so a server starts WITHOUT
+  re-ingesting the corpus;
+- :mod:`serving.server` — a long-lived server that loads the artifact
+  once, keeps device-resident postings and compiled batched runners warm,
+  drains a bounded request queue into padded micro-batches (the
+  ``grow_chunk_cap`` padding policy, so the batch-shape matrix is finite
+  and tier-2 proves zero per-request recompiles), fuses top-k on device,
+  and fronts it all with a hot-query LRU result cache.
+
+*RankMap* (platform-aware serving of dense decompositions, PAPERS.md) is
+the reference shape; DrJAX's one-jaxpr discipline is why the batched query
+step is a single registered jit entry point (``analysis/registry.py``:
+``tfidf_score_query_batch``) rather than per-request dispatches.
+"""
+
+from page_rank_and_tfidf_using_apache_spark_tpu.serving.artifact import (
+    ServableIndex,
+    load_index,
+    save_index,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+    ServeConfig,
+    TfidfServer,
+    batch_cap,
+    serve_pad_plan,
+)
+
+__all__ = [
+    "ServableIndex",
+    "ServeConfig",
+    "TfidfServer",
+    "batch_cap",
+    "load_index",
+    "save_index",
+    "serve_pad_plan",
+]

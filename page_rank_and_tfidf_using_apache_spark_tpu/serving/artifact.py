@@ -1,0 +1,166 @@
+"""Servable index artifact: build once, serve many (ISSUE 8).
+
+Reference counterpart: the Spark job's ``saveAsTextFile`` output that a
+downstream service would re-parse.  Here the build side (batch or
+streaming TF-IDF, optionally a PageRank run) is serialized ONCE into a
+versioned, mmap-loadable index directory, and the serving side
+(:mod:`serving.server`) starts by mapping it — no corpus re-ingest, no
+tokenizer warmup, no decompression.
+
+Format (``utils/checkpoint.save_array_dir`` — the checkpoint machinery's
+array-directory flavor)::
+
+    index_dir/
+      LATEST            -> "v0003"          (atomic pointer)
+      v0003/
+        META.json        {step: 3, config_hash, extra: {...}}
+        doc.npy term.npy weight.npy         postings COO, (term, doc)-sorted
+        idf.npy df.npy                      dense per-term tables
+        ranks.npy                           optional PageRank doc prior
+
+``extra`` carries everything the query side needs to hash queries the same
+way the build side hashed documents (the full TfidfConfig JSON), plus
+corpus stats (n_docs, nnz, vocab_bits).  ``config_hash`` guards semantic
+drift exactly like checkpoints do: a server refuses an index written under
+a different TF-IDF semantic configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import TfidfOutput
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    TfidfConfig,
+    config_to_json,
+)
+
+INDEX_FORMAT = 1  # bump on any layout/meaning change of the arrays below
+
+
+@dataclasses.dataclass(frozen=True)
+class ServableIndex:
+    """A loaded (mmap-backed by default) index version, ready for a server
+    to device_put.  Arrays are read-only views into the artifact files."""
+
+    path: str
+    version: int
+    n_docs: int
+    vocab_bits: int
+    cfg: TfidfConfig
+    doc: np.ndarray  # int32 [nnz]
+    term: np.ndarray  # int32 [nnz]
+    weight: np.ndarray  # f[nnz]
+    idf: np.ndarray  # f[vocab]
+    df: np.ndarray  # f[vocab]
+    ranks: np.ndarray | None  # f[n_docs] PageRank prior, or None
+    extra: dict
+
+    @property
+    def nnz(self) -> int:
+        return int(self.doc.shape[0])
+
+    @property
+    def vocab_size(self) -> int:
+        return 1 << self.vocab_bits
+
+
+def save_index(
+    directory: str,
+    output: TfidfOutput,
+    cfg: TfidfConfig,
+    *,
+    ranks: np.ndarray | None = None,
+    extra: dict | None = None,
+) -> str:
+    """Serialize a TF-IDF build (+ optional PageRank doc prior) as the next
+    index version under ``directory``; returns the version path.
+
+    ``ranks`` must be per-*document* priors aligned with the output's doc
+    ids (how documents map onto graph nodes is the caller's contract —
+    the PageRank-over-citation-graph correspondence of the reference).
+    """
+    if ranks is not None and ranks.shape[0] != output.n_docs:
+        raise ValueError(
+            f"ranks prior has {ranks.shape[0]} entries but the index holds "
+            f"{output.n_docs} documents"
+        )
+    arrays: dict[str, np.ndarray] = {
+        "doc": np.ascontiguousarray(output.doc, np.int32),
+        "term": np.ascontiguousarray(output.term, np.int32),
+        "weight": np.ascontiguousarray(output.weight),
+        "idf": np.ascontiguousarray(output.idf),
+        "df": np.ascontiguousarray(output.df),
+    }
+    if ranks is not None:
+        arrays["ranks"] = np.ascontiguousarray(ranks)
+    version = ckpt.next_version(directory)
+    meta = {
+        "format": INDEX_FORMAT,
+        "n_docs": int(output.n_docs),
+        "vocab_bits": int(output.vocab_bits),
+        "nnz": int(output.nnz),
+        "has_ranks": ranks is not None,
+        "tfidf_config": json.loads(config_to_json(cfg)),
+        **(extra or {}),
+    }
+    with obs.span("serve.index_build", version=version, nnz=output.nnz):
+        path = ckpt.save_array_dir(
+            directory, version, arrays, cfg.config_hash(), extra=meta
+        )
+    return path
+
+
+def load_index(
+    directory: str,
+    *,
+    version: int | None = None,
+    mmap: bool = True,
+    expect_config_hash: str | None = None,
+) -> ServableIndex:
+    """Load an index version (LATEST by default) as a :class:`ServableIndex`.
+
+    ``mmap=True`` maps the arrays instead of copying them: server startup
+    touches metadata only, and concurrent server processes share one page
+    cache for the postings."""
+    if version is not None:
+        import os
+
+        path = os.path.join(directory, f"v{version:04d}")
+    else:
+        path = ckpt.latest_array_dir(directory)
+        if path is None:
+            raise FileNotFoundError(
+                f"no committed index version under {directory!r} "
+                "(build one with serving.artifact.save_index / "
+                "cli.tfidf --save-index)"
+            )
+    ver, arrays, extra = ckpt.load_array_dir(
+        path, expect_config_hash, mmap=mmap
+    )
+    fmt = int(extra.get("format", 0))
+    if fmt != INDEX_FORMAT:
+        raise ValueError(
+            f"index {path} has format {fmt}; this build reads format "
+            f"{INDEX_FORMAT} — rebuild the artifact"
+        )
+    cfg = TfidfConfig(**extra["tfidf_config"])
+    return ServableIndex(
+        path=path,
+        version=int(ver),
+        n_docs=int(extra["n_docs"]),
+        vocab_bits=int(extra["vocab_bits"]),
+        cfg=cfg,
+        doc=arrays["doc"],
+        term=arrays["term"],
+        weight=arrays["weight"],
+        idf=arrays["idf"],
+        df=arrays["df"],
+        ranks=arrays.get("ranks"),
+        extra=extra,
+    )

@@ -34,6 +34,22 @@ from page_rank_and_tfidf_using_apache_spark_tpu import obs
 
 _META_KEY = "__ckpt_meta__"
 _CKPT_RE = re.compile(r"^ckpt_(\d{8})\.npz$")
+_VDIR_RE = re.compile(r"^v(\d{4})$")
+
+
+def _write_pointer(directory: str, name: str, pointer: str = "LATEST") -> None:
+    """Atomically flip the directory's pointer file to ``name`` — the same
+    tmp-file hygiene as the checkpoint payload write (a failure between
+    mkstemp and replace must not leak the tempfile)."""
+    ptr = os.path.join(directory, pointer)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(name)
+        os.replace(tmp, ptr)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def save_checkpoint(
@@ -65,18 +81,7 @@ def save_checkpoint(
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
-    # "latest" pointer, also atomic — and with the same tmp hygiene as the
-    # payload write: a failure between mkstemp and replace must not leak
-    # the tempfile (it previously did).
-    ptr = os.path.join(directory, "LATEST")
-    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            f.write(os.path.basename(path))
-        os.replace(tmp, ptr)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    _write_pointer(directory, os.path.basename(path))
     try:
         nbytes = os.path.getsize(path)
     except OSError:
@@ -144,6 +149,111 @@ def peek_meta(path: str) -> dict[str, Any]:
     accounting."""
     with np.load(path) as z:
         return json.loads(bytes(z[_META_KEY]).decode())
+
+
+# --------------------------------------------------------------------------
+# Versioned array directories (the serving-artifact substrate, ISSUE 8).
+#
+# ``.npz`` snapshots are zip containers: loading one decompresses every
+# member into fresh host memory, which is exactly wrong for a long-lived
+# server that wants the postings tables paged in on demand.  This second
+# format keeps the SAME metadata schema ({step, config_hash, extra}) and
+# the SAME atomic-pointer discipline, but stores each array as a bare
+# ``<name>.npy`` inside a ``v%04d`` directory — ``np.load(mmap_mode="r")``
+# then maps the file instead of copying it, so N server processes share
+# one page cache and startup touches no array bytes at all.
+# --------------------------------------------------------------------------
+
+
+def save_array_dir(
+    directory: str,
+    version: int,
+    arrays: dict[str, np.ndarray],
+    config_hash: str,
+    extra: dict[str, Any] | None = None,
+) -> str:
+    """Atomically write ``v{version:04d}/`` with one mmap-loadable ``.npy``
+    per array plus a ``META.json`` sidecar; flips the LATEST pointer last,
+    so a reader never sees a half-written version.  Returns the version
+    directory path."""
+    os.makedirs(directory, exist_ok=True)
+    name = f"v{version:04d}"
+    final = os.path.join(directory, name)
+    if os.path.exists(final):
+        raise FileExistsError(f"artifact version already exists: {final}")
+    tmp = tempfile.mkdtemp(dir=directory, prefix=f".{name}.")
+    try:
+        for key, value in arrays.items():
+            np.save(os.path.join(tmp, f"{key}.npy"), np.asarray(value))
+        meta = {"step": int(version), "config_hash": config_hash,
+                "extra": extra or {}}
+        with open(os.path.join(tmp, "META.json"), "w") as f:
+            json.dump(meta, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, final)  # atomic on POSIX: the dir appears whole
+    finally:
+        if os.path.exists(tmp):
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+    _write_pointer(directory, name)
+    nbytes = sum(
+        os.path.getsize(os.path.join(final, f)) for f in os.listdir(final)
+    )
+    obs.emit("artifact_save", path=final, version=int(version), bytes=nbytes)
+    obs.counter("artifact_saves")
+    return final
+
+
+def latest_array_dir(directory: str) -> str | None:
+    """Resolve the LATEST pointer to a version directory (None when the
+    directory holds no committed version)."""
+    ptr = os.path.join(directory, "LATEST")
+    if not os.path.exists(ptr):
+        return None
+    with open(ptr) as f:
+        name = f.read().strip()
+    path = os.path.join(directory, name)
+    return path if os.path.isdir(path) else None
+
+
+def next_version(directory: str) -> int:
+    """1 + the highest committed version number in ``directory`` (1 when
+    empty) — what a writer should pass to :func:`save_array_dir`."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return 1
+    versions = [int(m.group(1)) for n in names if (m := _VDIR_RE.match(n))]
+    return max(versions, default=0) + 1
+
+
+def load_array_dir(
+    path: str,
+    expect_config_hash: str | None = None,
+    *,
+    mmap: bool = True,
+) -> tuple[int, dict[str, np.ndarray], dict[str, Any]]:
+    """Load a version directory: (version, arrays, extra).  With ``mmap``
+    (the default) every array is an ``np.memmap`` view — pages fault in on
+    first touch, nothing is copied up front.  Raises on config-hash
+    mismatch, same contract as :func:`load_checkpoint`."""
+    with open(os.path.join(path, "META.json")) as f:
+        meta = json.load(f)
+    if expect_config_hash is not None and meta["config_hash"] != expect_config_hash:
+        raise ValueError(
+            f"artifact {path} was written under config {meta['config_hash']}, "
+            f"but current config is {expect_config_hash}; refusing to serve "
+            "across semantic changes"
+        )
+    arrays = {
+        n[:-4]: np.load(os.path.join(path, n),
+                        mmap_mode="r" if mmap else None)
+        for n in sorted(os.listdir(path))
+        if n.endswith(".npy")
+    }
+    obs.emit("artifact_load", path=path, version=int(meta["step"]))
+    return meta["step"], arrays, meta["extra"]
 
 
 def load_checkpoint(

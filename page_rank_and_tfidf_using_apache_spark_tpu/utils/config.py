@@ -40,6 +40,10 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         "GRAFT_LOG_LEVEL",  # stderr log level (utils/metrics.py; default INFO)
         "GRAFT_TRACE_DIR",  # obs/ run-telemetry output dir: traced runs write
         # <name>.<pid>.trace.jsonl + .manifest.json here (unset = no trace)
+        "GRAFT_TRACE_PARENT",  # cross-process trace id (obs/runtime.py): a
+        # parent process (bench.py) exports one id; every child run adopts
+        # it in its run_start event + manifest, so trace_report --stitch
+        # reassembles one trace tree for the whole round
     }
 )
 

@@ -88,6 +88,20 @@ class MetricsRecorder:
             f.write(self.to_json())
 
 
+def percentile(sorted_xs, p: float):
+    """Nearest-rank percentile over an ascending sequence (None when
+    empty) — the ONE convention every latency report uses (bench.py's
+    served-QPS block, cli.serve's summary, and tools/trace_report.py's
+    ``_pct``/``sync_p99`` implement the identical formula; trace_report
+    stays stdlib-only so it carries its own copy), so the same run never
+    reports two different p99s across artifacts."""
+    if not sorted_xs:
+        return None
+    n = len(sorted_xs)
+    rank = -(-int(p * 100) * n // 100)  # ceil without math
+    return sorted_xs[min(n - 1, max(0, rank - 1))]
+
+
 class Timer:
     """Wall-clock timer context; remember to block_until_ready() the device
     values inside the block — XLA dispatch is async."""

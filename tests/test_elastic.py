@@ -400,6 +400,81 @@ def test_tfidf_sharded_custom_axis_mesh_survives():
     np.testing.assert_allclose(res.to_dense(), base.to_dense(), atol=1e-6)
 
 
+# -------------------------------- stacked losses inside the shrink-rerun
+
+
+def test_pagerank_second_loss_inside_shrink_rerun_reenters(tmp_path):
+    """Elastic gap (ISSUE 8): the FIRST loss enters the shrink rung; the
+    SECOND fires at the rerun site (``pagerank_elastic_rerun``) while the
+    rebuilt mesh is re-running the failed segment — it must re-enter the
+    ladder (second shrink) instead of exhausting.  Two stacked
+    ``device_lost`` injections, two ``mesh.shrink`` spans, exact ranks."""
+    g = synthetic_powerlaw(800, 3200, seed=17)
+    cfg = PageRankConfig(iterations=8, checkpoint_every=4,
+                         checkpoint_dir=str(tmp_path / "ck"), **GRAPH_KW)
+    base = run_pagerank(g, PageRankConfig(iterations=8, **GRAPH_KW))
+    m = MetricsRecorder()
+    obs.start_run("elastic_stack", str(tmp_path / "tr"))
+    try:
+        with chaos.inject(
+            "pagerank_step:device_lost@dev:1;"
+            "pagerank_elastic_rerun:device_lost@dev:2"
+        ):
+            res = run_pagerank_sharded(g, cfg, n_devices=4, metrics=m)
+    finally:
+        obs.end_run()
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+    assert res.iterations == 8
+    degraded = [r for r in m.records if r.get("event") == "degraded"]
+    assert [(d["devices_old"], d["devices_new"]) for d in degraded] == \
+        [(4, 2), (2, 1)]
+    assert [d["ladder"] for d in degraded] == ["mesh_shrink", "single_device"]
+    trace = next((tmp_path / "tr").glob("elastic_stack.*.trace.jsonl"))
+    rep = _trace_report().report(str(trace))
+    assert len(rep["mesh_shrinks"]) == 2
+    assert not rep["exhausted"]
+
+
+def test_pagerank_second_loss_during_salvage_is_absorbed(tmp_path):
+    """A wildcard double injection: the second loss fires during the
+    salvage pull (pagerank_ckpt_pull) — the rung acknowledges it, retries
+    the salvage against the health registry, and ONE shrink absorbs both
+    dead devices.  The run completes either way; exhausting is the only
+    wrong answer."""
+    g = synthetic_powerlaw(700, 2800, seed=23)
+    cfg = PageRankConfig(iterations=8, checkpoint_every=4,
+                         checkpoint_dir=str(tmp_path / "ck"), **GRAPH_KW)
+    base = run_pagerank(g, PageRankConfig(iterations=8, **GRAPH_KW))
+    m = MetricsRecorder()
+    with chaos.inject("*:device_lost@dev:1;*:device_lost@dev:2"):
+        res = run_pagerank_sharded(g, cfg, n_devices=4, metrics=m)
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+    degraded = [r for r in m.records if r.get("event") == "degraded"]
+    assert degraded  # shrank at least once, exhausted never
+    assert elastic.health().lost() == frozenset({1, 2})
+
+
+def test_tfidf_second_loss_inside_reslice_reenters(tmp_path):
+    """The sharded-ingest counterpart: a second device dying while the
+    re-sliced in-flight super-chunk drains re-enters the shrink ladder
+    (4 -> 2 -> 1), commits every chunk exactly once, and matches the
+    uninterrupted output."""
+    chunks = _chunks(12)
+    base = run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10),
+                             n_devices=4)
+    elastic.reset_health()
+    m = MetricsRecorder()
+    with chaos.inject("*:device_lost@dev:1;*:device_lost@dev:2"):
+        res = run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10),
+                                n_devices=4, metrics=m)
+    np.testing.assert_allclose(res.to_dense(), base.to_dense(), atol=1e-6)
+    sc = [r for r in m.records if r.get("event") == "super_chunk"]
+    assert sum(r["devices"] for r in sc) == 12  # zero reprocessed chunks
+    degraded = [r for r in m.records if r.get("event") == "degraded"]
+    assert [(d["devices_old"], d["devices_new"]) for d in degraded] == \
+        [(4, 2), (2, 1)]
+
+
 # --------------------------------------- adaptive sync deadline satellites
 
 

@@ -390,6 +390,62 @@ def test_trace_report_cli(tmp_path):
     assert human.returncode == 0 and "phase.a" in human.stdout
 
 
+def test_sharded_per_device_timings_in_chunk_timeline(tmp_path):
+    """ROADMAP hardening (d): the sharded ingest publishes one
+    ``device_timing`` event per super-chunk; trace_report joins it into
+    the chunk timeline, so a straggling device is attributable from the
+    artifact alone."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+        run_tfidf_sharded,
+    )
+
+    docs = [f"tok{i} tok{i % 5} shared word" for i in range(16)]
+    chunks = [docs[i:i + 2] for i in range(0, len(docs), 2)]
+    obs.start_run("shardtime", str(tmp_path))
+    try:
+        run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10),
+                          n_devices=4)
+    finally:
+        obs.end_run()
+    trace = next(tmp_path.glob("shardtime.*.trace.jsonl"))
+    rep = _trace_report().report(str(trace))
+    timed = [c for c in rep["chunks"] if c.get("per_device_secs")]
+    assert timed, rep["chunks"]
+    for c in timed:
+        assert c["devices"] == len(c["per_device_secs"]) == 4
+        # waited in device order: the recorded times are non-decreasing
+        assert c["per_device_secs"] == sorted(c["per_device_secs"])
+        assert c["per_device_secs"][-1] >= 0
+
+
+def test_stitch_groups_children_by_trace_parent(tmp_path, monkeypatch):
+    """ROADMAP hardening (c): two child runs exporting the same
+    GRAFT_TRACE_PARENT stitch into one tree; an unparented run stays
+    outside it."""
+    monkeypatch.setenv("GRAFT_TRACE_PARENT", "round-7")
+    for name in ("child_a", "child_b"):
+        with obs.run(name, trace_dir=str(tmp_path)):
+            with obs.span("work"):
+                pass
+    monkeypatch.delenv("GRAFT_TRACE_PARENT")
+    with obs.run("loner", trace_dir=str(tmp_path)):
+        pass
+    mod = _trace_report()
+    doc = mod.stitch(str(tmp_path))
+    by_parent = {t["trace_parent"]: t for t in doc["trees"]}
+    assert {c["name"] for c in by_parent["round-7"]["children"]} == \
+        {"child_a", "child_b"}
+    assert {c["name"] for c in by_parent["(unparented)"]["children"]} == \
+        {"loner"}
+    # the stitched view is also reachable from the CLI (directory arg)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "trace_report.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert proc.returncode == 0 and "round-7" in proc.stdout
+
+
 # ---------------------------------------------------- bench integration
 
 

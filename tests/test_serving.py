@@ -1,0 +1,471 @@
+"""Serving-layer tests (ISSUE 8): artifact round-trip, served-vs-one-shot
+byte equality on the sklearn-oracle corpus, LRU hit identity, padded
+micro-batch policy, and chaos-degraded dispatch (errors isolated per
+batch, the queue keeps draining).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs, serving
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
+from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+    batch_cap,
+    batch_shape_matrix,
+    serve_pad_plan,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURE = Path(__file__).parent / "fixtures" / "tiny.txt"
+
+CFG = TfidfConfig(vocab_bits=10, idf_mode="smooth", l2_normalize=True)
+
+
+def _trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", REPO / "tools" / "trace_report.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def oracle_index(tmp_path_factory):
+    """The sklearn-oracle corpus (tests/fixtures/tiny.txt) built into a
+    servable index — the corpus test_tfidf_oracle.py pins numerically."""
+    docs = FIXTURE.read_text().splitlines()
+    out = run_tfidf(docs, CFG)
+    d = tmp_path_factory.mktemp("idx")
+    ranks = np.linspace(0.5, 1.5, out.n_docs).astype(np.float32)
+    serving.save_index(str(d), out, CFG, ranks=ranks)
+    return serving.load_index(str(d))
+
+
+def _one_shot(index, q_term, q_weight, k, n_docs=None):
+    """The pre-serving query path: a dense vocab vector through the
+    one-shot ops.score_query — the equality oracle."""
+    import jax.numpy as jnp
+
+    res = ops.TfidfResult(
+        doc=jnp.asarray(np.ascontiguousarray(index.doc)),
+        term=jnp.asarray(np.ascontiguousarray(index.term)),
+        weight=jnp.asarray(np.ascontiguousarray(index.weight)),
+        n_pairs=jnp.asarray(index.nnz),
+        valid=jnp.ones(index.nnz, index.weight.dtype),
+        idf=jnp.asarray(np.ascontiguousarray(index.idf)),
+        df=jnp.asarray(np.ascontiguousarray(index.df)),
+    )
+    q = np.zeros(index.vocab_size, index.weight.dtype)
+    np.add.at(q, q_term, q_weight)
+    scores, idx = ops.score_query(
+        res, jnp.asarray(q), n_docs=n_docs or index.n_docs, k=k
+    )
+    return np.asarray(scores), np.asarray(idx)
+
+
+# ----------------------------------------------------------------- artifact
+
+
+def test_artifact_roundtrip_and_versioning(tmp_path, oracle_index):
+    docs = FIXTURE.read_text().splitlines()
+    out = run_tfidf(docs, CFG)
+    p1 = serving.save_index(str(tmp_path), out, CFG)
+    assert p1.endswith("v0001")
+    p2 = serving.save_index(str(tmp_path), out, CFG)
+    assert p2.endswith("v0002")
+    idx = serving.load_index(str(tmp_path))  # LATEST -> v0002
+    assert idx.version == 2
+    old = serving.load_index(str(tmp_path), version=1)
+    assert old.version == 1
+    np.testing.assert_array_equal(idx.weight, old.weight)
+    assert idx.n_docs == out.n_docs and idx.nnz == out.nnz
+    assert idx.cfg.config_hash() == CFG.config_hash()
+    assert idx.ranks is None  # built without a prior here
+
+
+def test_artifact_is_mmap_loadable(tmp_path):
+    docs = FIXTURE.read_text().splitlines()
+    out = run_tfidf(docs, CFG)
+    serving.save_index(str(tmp_path), out, CFG)
+    idx = serving.load_index(str(tmp_path), mmap=True)
+    assert isinstance(idx.weight, np.memmap)  # mapped, not copied
+    ram = serving.load_index(str(tmp_path), mmap=False)
+    assert not isinstance(ram.weight, np.memmap)
+    np.testing.assert_array_equal(np.asarray(idx.weight), ram.weight)
+
+
+def test_artifact_config_hash_guard(tmp_path):
+    docs = FIXTURE.read_text().splitlines()
+    out = run_tfidf(docs, CFG)
+    serving.save_index(str(tmp_path), out, CFG)
+    other = TfidfConfig(vocab_bits=10)  # different semantics
+    with pytest.raises(ValueError, match="refusing to serve"):
+        serving.load_index(
+            str(tmp_path), expect_config_hash=other.config_hash()
+        )
+
+
+def test_artifact_ranks_shape_guard(tmp_path):
+    docs = FIXTURE.read_text().splitlines()
+    out = run_tfidf(docs, CFG)
+    with pytest.raises(ValueError, match="ranks prior"):
+        serving.save_index(
+            str(tmp_path), out, CFG, ranks=np.ones(out.n_docs + 3, np.float32)
+        )
+
+
+def test_array_dir_atomicity_and_pointer(tmp_path):
+    """The underlying checkpoint-machinery format: LATEST flips only after
+    the version directory is fully in place, and versions are immutable."""
+    d = str(tmp_path)
+    ckpt.save_array_dir(d, 1, {"a": np.arange(4)}, "h")
+    assert ckpt.latest_array_dir(d).endswith("v0001")
+    assert ckpt.next_version(d) == 2
+    with pytest.raises(FileExistsError):
+        ckpt.save_array_dir(d, 1, {"a": np.arange(4)}, "h")
+    step, arrays, extra = ckpt.load_array_dir(ckpt.latest_array_dir(d))
+    assert step == 1 and list(arrays) == ["a"]
+    with pytest.raises(ValueError, match="refusing"):
+        ckpt.load_array_dir(ckpt.latest_array_dir(d), "other-hash")
+
+
+# ---------------------------------------------------- served == one-shot
+
+
+def test_served_topk_byte_equal_to_one_shot(oracle_index):
+    """Acceptance: the warm batched path returns byte-identical top-k to
+    the one-shot ops.score_query on the sklearn-oracle corpus.  (The
+    fixture is the SNAP-format tiny graph read as text lines, so its
+    vocabulary is the SNAP header words and node ids.)"""
+    queries = [
+        ["directed", "graph"],
+        ["node"],
+        ["0", "1"],
+        ["dangling", "node", "4"],
+        ["zebra", "unseen"],  # all-zero scores still well-defined
+    ]
+    with serving.TfidfServer(
+        oracle_index, serving.ServeConfig(top_k=4, max_batch=4)
+    ) as srv:
+        futs = [srv.submit(q) for q in queries]
+        for q, fut in zip(queries, futs):
+            scores, idx = fut.result(30.0)
+            qt, qw = srv.make_query(q)
+            e_scores, e_idx = _one_shot(oracle_index, qt, qw, srv.k)
+            assert scores.tobytes() == e_scores.tobytes()
+            assert idx.tobytes() == e_idx.tobytes()
+
+
+def test_served_rank_prior_blend(oracle_index):
+    """rank_alpha fuses the artifact's PageRank prior on device:
+    score + alpha * rank, before top-k."""
+    alpha = 0.25
+    with serving.TfidfServer(
+        oracle_index,
+        serving.ServeConfig(top_k=oracle_index.n_docs, rank_alpha=alpha),
+    ) as srv:
+        scores, idx = srv.query(["directed", "graph"])
+        qt, qw = srv.make_query(["directed", "graph"])
+    base_scores, _ = _one_shot(oracle_index, qt, qw, oracle_index.n_docs)
+    # undo top-k ordering: scatter both back to doc order
+    served = np.zeros(oracle_index.n_docs, np.float32)
+    served[idx] = scores
+    expect = base_scores.copy()
+    order = np.argsort(-base_scores, kind="stable")
+    dense = np.zeros_like(served)
+    dense[_one_shot(oracle_index, qt, qw, oracle_index.n_docs)[1]] = base_scores
+    expect_dense = dense + alpha * np.asarray(oracle_index.ranks)
+    np.testing.assert_allclose(served, expect_dense, atol=1e-6)
+    del expect, order
+
+
+def test_lru_hit_returns_identical_results(oracle_index):
+    with serving.TfidfServer(
+        oracle_index, serving.ServeConfig(top_k=3)
+    ) as srv:
+        s1, i1 = srv.query(["node", "graph"])
+        s2, i2 = srv.query(["graph", "node"])  # canonicalized: same key
+        s3, i3 = srv.query(["node", "graph"])
+        stats = srv.stats()
+    assert s1.tobytes() == s2.tobytes() == s3.tobytes()
+    assert np.array_equal(i1, i2) and np.array_equal(i2, i3)
+    assert stats["cache_hits"] == 2 and stats["cache_misses"] == 1
+
+
+def test_lru_eviction_bound(oracle_index):
+    with serving.TfidfServer(
+        oracle_index, serving.ServeConfig(top_k=2, cache_size=2)
+    ) as srv:
+        srv.query(["node"])
+        srv.query(["graph"])
+        srv.query(["edge"])  # evicts "node"
+        srv.query(["node"])  # miss again
+        stats = srv.stats()
+    assert stats["cache_misses"] == 4 and stats["cache_hits"] == 0
+    assert len(srv._cache) == 2
+
+
+def test_cache_disabled(oracle_index):
+    with serving.TfidfServer(
+        oracle_index, serving.ServeConfig(top_k=2, cache_size=0)
+    ) as srv:
+        a = srv.query(["node"])
+        b = srv.query(["node"])
+        stats = srv.stats()
+    assert stats["cache_hits"] == 0 and stats["cache_misses"] == 2
+    assert a[0].tobytes() == b[0].tobytes()
+
+
+# ------------------------------------------------------- batching policy
+
+
+def test_batch_cap_is_grow_chunk_cap_at_min_bits_zero():
+    m = MetricsRecorder()
+    assert [batch_cap(b, 8, m) for b in (1, 2, 3, 4, 5, 7, 8)] == \
+        [1, 2, 4, 4, 8, 8, 8]
+    assert batch_cap(100, 8, m) == 8  # clipped at max_batch
+
+
+def test_batch_shape_matrix_finite():
+    assert batch_shape_matrix(8) == [1, 2, 4, 8]
+    assert batch_shape_matrix(1) == [1]
+    assert batch_shape_matrix(6) == [1, 2, 4, 6]  # clip keeps it bounded
+
+
+def test_serve_pad_plan_matches_policy():
+    (label, frac), = serve_pad_plan((1, 2, 3, 5, 7, 8), 8)
+    assert label == "serve"
+    # raw 26 slots over caps 1+2+4+8+8+8=31
+    assert frac == pytest.approx((31 - 26) / 31)
+
+
+def test_registry_covers_batched_serve_entry():
+    """The batched entry's declared shape matrix must collapse to the
+    warm set — tier-2's zero-per-request-recompile proof rides on it."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.analysis import registry
+
+    ep = {e.name: e for e in registry.ENTRY_POINTS}["tfidf_score_query_batch"]
+    assert ep.max_compiles == len(batch_shape_matrix(registry.SERVE_MAX_BATCH))
+    t = registry.build_traceable(ep)
+    import jax
+
+    sigs = {
+        tuple((tuple(l.shape), str(l.dtype))
+              for l in jax.tree_util.tree_leaves(args))
+        for _, args in t.variants
+    }
+    assert len(sigs) <= ep.max_compiles
+
+
+def test_make_query_applies_index_tokenizer(oracle_index):
+    """Query terms run through the INDEX's real tokenizer: punctuation
+    splits exactly like the corpus did, so 'from-node' scores like
+    'from node' instead of hashing to a term no document produced."""
+    srv = serving.TfidfServer(oracle_index, serving.ServeConfig(top_k=2))
+    qt1, qw1 = srv.make_query(["from-node"])
+    qt2, qw2 = srv.make_query(["from", "node"])
+    np.testing.assert_array_equal(qt1, qt2)
+    np.testing.assert_array_equal(qw1, qw2)
+
+
+def test_make_query_builds_ngrams(tmp_path):
+    """An ngram=2 index's server generates the same space-joined bigram
+    ids the build side hashed — bigram queries are servable."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
+        fnv1a_64,
+        hash_to_vocab,
+    )
+
+    cfg2 = TfidfConfig(vocab_bits=10, ngram=2)
+    out = run_tfidf(["alpha beta gamma", "beta gamma delta"], cfg2)
+    serving.save_index(str(tmp_path), out, cfg2)
+    idx = serving.load_index(str(tmp_path))
+    srv = serving.TfidfServer(idx, serving.ServeConfig(top_k=2))
+    qt, _ = srv.make_query(["alpha", "beta"])
+    bigram_id = int(hash_to_vocab(fnv1a_64(["alpha beta"]), 10)[0])
+    assert bigram_id in qt.tolist()
+
+
+def test_stop_fails_raced_submit_instead_of_hanging(oracle_index):
+    """A request slipping into the queue around shutdown is failed by
+    stop()'s leftover drain, and post-stop submits refuse — no future can
+    hang forever on a dead drain thread."""
+    srv = serving.TfidfServer(oracle_index, serving.ServeConfig(top_k=2))
+    srv.start()
+    qt, qw = srv.make_query(["node"])
+    from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+        _Pending,
+    )
+
+    srv._thread.join(0)  # still alive; now simulate the race:
+    leftover = _Pending(srv.query_key(qt, qw), qt, qw)
+    srv._queue.put(leftover)  # may land after the _STOP sentinel
+    srv.stop()
+    assert leftover.done  # resolved OR failed by the leftover drain
+    with pytest.raises(RuntimeError, match="not started"):
+        srv.submit(["node"])
+
+
+def test_query_truncation_and_empty(oracle_index):
+    with serving.TfidfServer(
+        oracle_index,
+        serving.ServeConfig(top_k=2, max_query_terms=4),
+    ) as srv:
+        qt, qw = srv.make_query([f"t{i}" for i in range(20)])
+        assert qt.shape[0] == 4
+        qe, we = srv.make_query([])
+        assert qe.shape[0] == 0
+        scores, idx = srv.query([])  # empty query: all-prior/zero scores
+        assert scores.shape == (2,)
+
+
+def test_warmup_compiles_full_shape_matrix(oracle_index):
+    srv = serving.TfidfServer(
+        oracle_index, serving.ServeConfig(top_k=2, max_batch=8)
+    )
+    try:
+        srv.start(warm=False)
+        assert srv.warmup() == [1, 2, 4, 8]
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------- chaos resilience
+
+
+def test_chaos_transient_faults_are_invisible(oracle_index):
+    """fail@%5 on the serve dispatch site: the executor retries; every
+    request still succeeds and matches the clean run."""
+    queries = [["node", f"w{i}"] for i in range(16)] + [["directed"]] * 2
+    with serving.TfidfServer(
+        oracle_index, serving.ServeConfig(top_k=3, cache_size=0, max_batch=2)
+    ) as srv:
+        clean = [srv.query(q) for q in queries[:4]]
+    m = MetricsRecorder()
+    with chaos.inject("serve_dispatch:fail@%5") as plan:
+        with serving.TfidfServer(
+            oracle_index,
+            serving.ServeConfig(top_k=3, cache_size=0, max_batch=2),
+            metrics=m,
+        ) as srv:
+            # sequential queries: every request is its own micro-batch, so
+            # the %5 schedule deterministically hits the 5th, 10th, ...
+            # dispatch regardless of drain timing
+            results = [srv.query(q, timeout=60.0) for q in queries]
+            stats = srv.stats()
+        assert plan.call_count("serve_dispatch") >= len(queries)
+    assert stats["batch_errors"] == 0
+    for (s, i), (cs, ci) in zip(results[:4], clean):
+        assert s.tobytes() == cs.tobytes() and np.array_equal(i, ci)
+    retries = [r for r in m.records if r.get("event") == "retry"
+               and r.get("site") == "serve_dispatch"]
+    assert retries  # the injection really fired and was absorbed
+
+
+def test_chaos_hard_fault_degrades_per_request(oracle_index):
+    """A persistent loss at the dispatch site fails exactly the batch that
+    hit it; the queue keeps draining and later requests succeed."""
+    with chaos.inject("serve_dispatch:lost@1"):
+        with serving.TfidfServer(
+            oracle_index,
+            serving.ServeConfig(top_k=3, cache_size=0, max_batch=2,
+                                flush_ms=50.0),
+        ) as srv:
+            first = srv.submit(["node"])
+            second = srv.submit(["graph"])
+            with pytest.raises(Exception):
+                first.result(60.0)
+            with pytest.raises(Exception):
+                second.result(60.0)  # same micro-batch: same fault
+            # the drain loop survived — fresh requests serve fine
+            scores, idx = srv.query(["directed", "graph"], timeout=60.0)
+            stats = srv.stats()
+    assert stats["batch_errors"] == 1
+    assert scores.shape == (3,)
+    qt, qw = srv.make_query(["directed", "graph"])
+    es, ei = _one_shot(oracle_index, qt, qw, 3)
+    assert scores.tobytes() == es.tobytes()
+
+
+# ----------------------------------------------------- telemetry + stitch
+
+
+def test_serve_trace_accounting(oracle_index, tmp_path, monkeypatch):
+    """A traced serve run leaves queue-wait/pad/dispatch/pull accounting
+    and per-request latency percentiles readable by trace_report; with
+    GRAFT_TRACE_PARENT set, the artifact joins the parent's stitched
+    tree (ROADMAP hardening (c))."""
+    monkeypatch.setenv("GRAFT_TRACE_PARENT", "round-42")
+    obs.start_run("serve", str(tmp_path))
+    try:
+        with serving.TfidfServer(
+            oracle_index, serving.ServeConfig(top_k=3, max_batch=4)
+        ) as srv:
+            srv.query(["directed", "graph"])  # populate the cache
+            futs = [srv.submit(["directed", "graph"]) for _ in range(6)]
+            futs += [srv.submit([f"w{i}"]) for i in range(5)]
+            for f in futs:
+                f.result(60.0)
+    finally:
+        obs.end_run()
+    mod = _trace_report()
+    trace = next(tmp_path.glob("serve.*.trace.jsonl"))
+    rep = mod.report(str(trace))
+    assert rep["trace_parent"] == "round-42"
+    sv = rep["serving"]
+    assert sv["requests"] == 12
+    assert sv["cache_hits"] >= 6  # the 6 resubmits of the cached query
+    assert sv["errors"] == 0
+    assert sv["latency_p99_s"] >= sv["latency_p50_s"] >= 0
+    assert {"dispatch", "pull"} <= set(sv["phases"])
+    man = json.loads(next(tmp_path.glob("serve.*.manifest.json")).read_text())
+    assert man["trace_parent"] == "round-42"
+    stitched = mod.stitch(str(tmp_path))
+    (tree,) = stitched["trees"]
+    assert tree["trace_parent"] == "round-42"
+    assert tree["children"][0]["serving"]["requests"] == 12
+
+
+# -------------------------------------------------------------------- CLI
+
+
+def test_cli_build_and_serve(tmp_path, capsys):
+    from page_rank_and_tfidf_using_apache_spark_tpu.cli import serve as cli_serve
+    from page_rank_and_tfidf_using_apache_spark_tpu.cli import tfidf as cli_tfidf
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(FIXTURE.read_text())
+    idx_dir = tmp_path / "index"
+    rc = cli_tfidf.main([
+        str(corpus), "--lines", "--vocab-bits", "10", "--idf-mode", "smooth",
+        "--l2-normalize", "--save-index", str(idx_dir),
+    ])
+    assert rc == 0
+    assert (idx_dir / "LATEST").exists()
+
+    queries = tmp_path / "q.txt"
+    queries.write_text("directed graph\nnode\n\n0 1\n")
+    rc = cli_serve.main([
+        str(idx_dir), "--queries", str(queries), "--top-k", "3",
+        "--max-batch", "2",
+    ])
+    assert rc == 0
+    out, err = capsys.readouterr()
+    rows = [l.split("\t") for l in out.strip().splitlines() if l]
+    assert rows and all(len(r) == 3 for r in rows)
+    qids = {int(r[0]) for r in rows}
+    assert 0 in qids  # "directed graph" matched something
+    stats = json.loads(err.strip().splitlines()[-1])
+    assert stats["requests"] == 3 and stats["p50_ms"] is not None

@@ -48,9 +48,10 @@ echo "== trace-diff gate (per-phase regression across committed rounds) =="
 # Compare the two newest committed BENCH rounds: a per-phase wall-time
 # regression past GRAFT_TRACE_DIFF_THRESHOLD (default 35%) in the
 # committed trajectory fails CI — the round that paid it must explain
-# itself before the next one lands on top.  rc=2 (a round without
-# extra.breakdown, e.g. pre-PR-4 artifacts) skips the gate with a notice:
-# it arms itself the first time two breakdown-carrying rounds exist.
+# itself before the next one lands on top.  ENFORCING since ISSUE 8: the
+# two newest committed rounds (r06+) carry extra.breakdown, so rc=2 — a
+# round missing its breakdown — is itself a regression (the bench lost
+# its accounting), not a soft skip.
 # `|| true`: zero matching rounds must take the skip branch below, not
 # kill the script via set -e/pipefail; sort -V keeps r100 after r99
 rounds=$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -2 || true)
@@ -66,7 +67,9 @@ if [ "$(echo "$rounds" | grep -c .)" -eq 2 ]; then
         echo "FAIL: $cur regressed a phase past ${GRAFT_TRACE_DIFF_THRESHOLD:-0.35} vs $prev" >&2
         exit 1
     elif [ "$diff_rc" -eq 2 ]; then
-        echo "trace-diff gate: skipped ($prev/$cur carry no per-phase breakdown)"
+        echo "FAIL: $prev/$cur are not comparable (missing extra.breakdown)" >&2
+        echo "      — committed rounds must carry their per-phase accounting" >&2
+        exit 1
     fi
 else
     echo "trace-diff gate: skipped (fewer than two committed rounds)"

@@ -23,6 +23,8 @@ into the BENCH record's ``extra.breakdown`` — no stderr scraping.
 Usage::
 
     python tools/trace_report.py RUN.trace.jsonl [--json]
+    python tools/trace_report.py TRACE_DIR [--json]   # stitch: group every
+        # child run under its GRAFT_TRACE_PARENT id into one round tree
 """
 
 from __future__ import annotations
@@ -112,6 +114,14 @@ def _tally(events: list[dict[str, Any]], kind: str) -> dict[str, int]:
     return out
 
 
+def _pct(sorted_xs: list[float], p: float) -> float | None:
+    """Nearest-rank percentile over an ascending list (None when empty)."""
+    if not sorted_xs:
+        return None
+    i = min(len(sorted_xs) - 1, max(0, -(-int(p * 100) * len(sorted_xs) // 100) - 1))
+    return sorted_xs[i]
+
+
 def report(path: str) -> dict[str, Any]:
     """Full accounting for one trace file, as a JSON-ready dict."""
     events, bad = load_events(path)
@@ -150,6 +160,16 @@ def report(path: str) -> dict[str, Any]:
         s["count"] += 1
         s["secs"] += rec["secs"]
 
+    # Per-device timings (ROADMAP hardening (d)): the sharded ingest
+    # publishes one ``device_timing`` event per super-chunk with each
+    # device's shard-ready time, keyed by step — joined into the chunk
+    # timeline below so a straggling device is visible per chunk.
+    device_timings = {
+        e.get("step"): e
+        for e in events
+        if e["kind"] == "device_timing" and e.get("step") is not None
+    }
+
     chunks = sorted(
         (
             {
@@ -157,9 +177,19 @@ def report(path: str) -> dict[str, Any]:
                 "secs": rec["secs"],
                 "t_rel": rec["t0"] - t0,
                 "complete": rec["complete"],
+                **(
+                    {
+                        "devices": device_timings[rec["attrs"]["step"]].get("devices"),
+                        "per_device_secs": device_timings[rec["attrs"]["step"]].get("secs"),
+                    }
+                    if rec["name"] == "tfidf.super_chunk"
+                    and rec["attrs"].get("step") in device_timings
+                    else {}
+                ),
             }
             for rec in all_spans
-            if rec["name"] == "tfidf.chunk" and "chunk" in rec["attrs"]
+            if rec["name"] in ("tfidf.chunk", "tfidf.super_chunk")
+            and "chunk" in rec["attrs"]
         ),
         key=lambda c: c["t_rel"],
     )
@@ -200,6 +230,29 @@ def report(path: str) -> dict[str, Any]:
             "thread": deepest.get("thread"),
         }
 
+    # Serving-path accounting (ISSUE 8): per-request ``serve_request``
+    # events carry queue-wait and total latency; the serve.pad/dispatch/
+    # pull spans give the phase split.  Present only for serve runs.
+    serve_reqs = [e for e in events if e["kind"] == "serve_request"]
+    serving = None
+    if serve_reqs:
+        lat = sorted(e.get("total_s", 0.0) for e in serve_reqs)
+        qw = sorted(e.get("queue_wait_s", 0.0) for e in serve_reqs)
+        serving = {
+            "requests": len(serve_reqs),
+            "cache_hits": sum(e.get("cache") == "hit" for e in serve_reqs),
+            "errors": sum(1 for e in serve_reqs if e.get("error")),
+            "latency_p50_s": _pct(lat, 0.50),
+            "latency_p99_s": _pct(lat, 0.99),
+            "queue_wait_p50_s": _pct(qw, 0.50),
+            "queue_wait_p99_s": _pct(qw, 0.99),
+            "phases": {
+                name.split(".", 1)[1]: round(span_stats[name]["secs"], 4)
+                for name in ("serve.pad", "serve.dispatch", "serve.pull")
+                if name in span_stats
+            },
+        }
+
     manifest = None
     mpath = path.replace(".trace.jsonl", ".manifest.json")
     if mpath != path and os.path.exists(mpath):
@@ -212,6 +265,11 @@ def report(path: str) -> dict[str, Any]:
     return {
         "trace": path,
         "manifest": manifest,
+        "trace_parent": (
+            (run_start or {}).get("trace_parent")
+            or (manifest or {}).get("trace_parent")
+        ),
+        "serving": serving,
         "events": len(events),
         "bad_lines": bad,
         "complete": run_end is not None,
@@ -266,9 +324,80 @@ def sync_p99(path: str, span_names: frozenset = SYNC_SPAN_NAMES) -> float | None
         for e in events
         if e["kind"] == "span_end" and e.get("name") in span_names
     )
-    if not secs:
-        return None
-    return secs[min(len(secs) - 1, max(0, -(-99 * len(secs) // 100) - 1))]
+    return _pct(secs, 0.99)
+
+
+def stitch(root: str) -> dict[str, Any]:
+    """Reassemble one trace TREE from a directory of per-process artifacts
+    (ROADMAP hardening (c)): every ``*.trace.jsonl`` under ``root``
+    (recursively) whose run adopted a ``GRAFT_TRACE_PARENT`` id is grouped
+    under that id; runs without one group under ``"(unparented)"``.  The
+    result is the whole-round accounting the bench parent could never see
+    from any single child: per-child wall/status/breakdown plus the round
+    totals, keyed by the id the parent exported."""
+    import glob
+
+    paths = sorted(
+        glob.glob(os.path.join(root, "**", "*.trace.jsonl"), recursive=True),
+        key=os.path.getmtime,
+    )
+    trees: dict[str, dict[str, Any]] = {}
+    for p in paths:
+        try:
+            rep = report(p)
+        except OSError:
+            continue
+        if rep.get("empty"):
+            continue
+        parent = rep.get("trace_parent") or "(unparented)"
+        tree = trees.setdefault(
+            parent, {"trace_parent": parent, "children": [],
+                     "wall_secs": 0.0, "retries": 0, "checkpoints": 0}
+        )
+        man = rep.get("manifest") or {}
+        tree["children"].append({
+            "name": man.get("name") or os.path.basename(p).split(".")[0],
+            "pid": man.get("pid"),
+            "trace": p,
+            "status": rep["status"],
+            "wall_secs": round(rep["wall_secs"], 3),
+            "breakdown": {k: round(v, 3) for k, v in rep["breakdown"].items()},
+            "serving": rep.get("serving"),
+        })
+        tree["wall_secs"] = round(tree["wall_secs"] + rep["wall_secs"], 3)
+        tree["retries"] += sum(rep["retries"].values())
+        tree["checkpoints"] += rep["checkpoints"]
+    return {"root": root, "trees": sorted(
+        trees.values(), key=lambda t: -len(t["children"])
+    )}
+
+
+def render_stitched(doc: dict[str, Any]) -> str:
+    lines = [f"stitched trace root: {doc['root']}"]
+    if not doc["trees"]:
+        lines.append("  (no trace artifacts found)")
+    for tree in doc["trees"]:
+        lines.append(
+            f"trace {tree['trace_parent']}: {len(tree['children'])} child "
+            f"run(s), {tree['wall_secs']:.3f}s total wall, "
+            f"{tree['retries']} retries, {tree['checkpoints']} checkpoints"
+        )
+        for ch in tree["children"]:
+            top = sorted(ch["breakdown"].items(), key=lambda kv: -kv[1])[:3]
+            phases = ", ".join(f"{k} {v:.2f}s" for k, v in top)
+            lines.append(
+                f"  {ch['name']:16s} pid={ch['pid']} {ch['status']:10s} "
+                f"{ch['wall_secs']:9.3f}s  {phases}"
+            )
+            if ch.get("serving"):
+                sv = ch["serving"]
+                lines.append(
+                    f"  {'':16s} serving: {sv['requests']} req, "
+                    f"{sv['cache_hits']} hits, p50 "
+                    f"{(sv['latency_p50_s'] or 0) * 1e3:.1f}ms p99 "
+                    f"{(sv['latency_p99_s'] or 0) * 1e3:.1f}ms"
+                )
+    return "\n".join(lines)
 
 
 def render_human(rep: dict[str, Any]) -> str:
@@ -302,9 +431,28 @@ def render_human(rep: dict[str, Any]) -> str:
         )
         worst = sorted(done, key=lambda c: -c["secs"])[:5]
         for c in worst:
+            dev = ""
+            if c.get("per_device_secs"):
+                dev = "  devices [" + ", ".join(
+                    f"{s:.4f}s" for s in c["per_device_secs"]
+                ) + "]"
             lines.append(
                 f"  chunk {c['chunk']}: {c['secs']:.4f}s (at +{c['t_rel']:.2f}s)"
+                f"{dev}"
             )
+    if rep.get("serving"):
+        sv = rep["serving"]
+        lines.append(
+            f"serving: {sv['requests']} requests ({sv['cache_hits']} cache "
+            f"hits, {sv['errors']} errors), latency p50 "
+            f"{(sv['latency_p50_s'] or 0) * 1e3:.2f}ms / p99 "
+            f"{(sv['latency_p99_s'] or 0) * 1e3:.2f}ms, queue-wait p50 "
+            f"{(sv['queue_wait_p50_s'] or 0) * 1e3:.2f}ms"
+        )
+        if sv["phases"]:
+            lines.append("  " + ", ".join(
+                f"{k} {v:.3f}s" for k, v in sv["phases"].items()
+            ))
     for key in ("retries", "chaos", "watchdog", "degraded", "exhausted",
                 "shrinks"):
         if rep.get(key):
@@ -330,9 +478,16 @@ def render_human(rep: dict[str, Any]) -> str:
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="trace_report", description=__doc__)
-    ap.add_argument("trace", help="path to a <name>.<pid>.trace.jsonl file")
+    ap.add_argument("trace", help="a <name>.<pid>.trace.jsonl file, or a "
+                                  "directory to stitch (all children of one "
+                                  "GRAFT_TRACE_PARENT id become one tree)")
     ap.add_argument("--json", action="store_true", help="machine-readable output")
     args = ap.parse_args(argv)
+    if os.path.isdir(args.trace):
+        doc = stitch(args.trace)
+        print(json.dumps(doc, indent=2, default=str) if args.json
+              else render_stitched(doc))
+        return 0
     if not os.path.exists(args.trace):
         print(f"trace_report: no such file: {args.trace}", file=sys.stderr)
         return 2
