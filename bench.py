@@ -484,6 +484,151 @@ def _measure_serve_on_index(obs, docs, cfg, idx_dir: str) -> dict:
     }
 
 
+def measure_workloads() -> dict:
+    """Dataflow-workloads bench (ISSUE 9): trajectory numbers for the
+    three workloads the dataflow core opened —
+
+    - ``ppr_batch_queries_per_sec``: a B-query batch of personalized
+      PageRank runs as ONE vmapped fixpoint over the shared bench graph;
+      queries/sec = B / warm wall for ``BENCH_PPR_ITERS`` iterations.
+    - ``cc_iters_per_sec``: min-label-propagation rounds/sec on the same
+      graph (capped rounds — a throughput gauge, not a convergence race).
+    - ``bm25_vs_tfidf_served_qps``: the serving A/B — the same corpus
+      index served under each ranker through the warm batched path.
+    """
+    from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+    with obs.run("workloads"):
+        return _measure_workloads_traced(obs)
+
+
+def _measure_workloads_traced(obs) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.components import (
+        make_components_runner,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.ppr import (
+        make_ppr_batch_runner,
+        restart_batch,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+        ComponentsConfig,
+        PageRankConfig,
+    )
+
+    out: dict = {"backend": jax.default_backend()}
+    with obs.span("bench.graph"):
+        graph = _build_graph()
+        n = graph.n_nodes
+
+    # --- batched personalized PageRank ---
+    b = int(os.environ.get("BENCH_PPR_BATCH", 8))
+    ppr_iters = int(os.environ.get("BENCH_PPR_ITERS", 10))
+    cfg = PageRankConfig(iterations=ppr_iters, dangling="redistribute",
+                         init="uniform", spmv_impl="cumsum")
+    rng = np.random.default_rng(SEED)
+    queries = [[int(graph.node_ids[i])]
+               for i in rng.integers(0, n, size=b)]
+    with obs.span("bench.ppr_setup"):
+        dg = ops.put_graph(graph, "float32")
+        e_b = jax.device_put(restart_batch(graph, cfg, queries))
+        runner = make_ppr_batch_runner(n, cfg)
+        ranks0_host = np.broadcast_to(
+            ops.init_ranks(n, cfg), (b, n)
+        ).copy()
+
+    def ppr_once():
+        r0 = jax.device_put(ranks0_host)
+        float(r0[0, 0])  # fence the H2D put outside the timed region
+        t0 = time.perf_counter()
+        ranks, it, delta = runner(dg, r0, e_b)
+        checksum = float(jnp.sum(ranks))
+        return time.perf_counter() - t0, checksum
+
+    with obs.span("bench.ppr_compile"):
+        ppr_once()
+    with obs.span("bench.ppr"):
+        secs, checksum = min(ppr_once() for _ in range(2))
+    out["ppr_batch_queries_per_sec"] = round(b / secs, 3)
+    out["ppr_batch"] = b
+    out["ppr_iters"] = ppr_iters
+    log(f"[workloads] ppr: {b} queries x {ppr_iters} iters in {secs:.2f}s "
+        f"-> {out['ppr_batch_queries_per_sec']} q/s (checksum {checksum:.3f})")
+
+    # --- connected components (label propagation) ---
+    cc_rounds = int(os.environ.get("BENCH_CC_ROUNDS", 20))
+    ccfg = ComponentsConfig(iterations=cc_rounds, tol=0.0)  # fixed rounds
+    with obs.span("bench.cc_setup"):
+        cc_runner = make_components_runner(n, ccfg)
+        labels_host = np.arange(n, dtype=np.int32)
+
+    def cc_once():
+        l0 = jax.device_put(labels_host)
+        int(l0[0])
+        t0 = time.perf_counter()
+        labels, it, changed = cc_runner(dg, l0)
+        k = int(labels[0])  # scalar fence
+        return time.perf_counter() - t0, k
+
+    with obs.span("bench.cc_compile"):
+        cc_once()
+    with obs.span("bench.cc"):
+        secs, _ = min(cc_once() for _ in range(2))
+    out["cc_iters_per_sec"] = round(cc_rounds / secs, 3)
+    out["cc_rounds"] = cc_rounds
+    log(f"[workloads] cc: {cc_rounds} rounds in {secs:.2f}s -> "
+        f"{out['cc_iters_per_sec']} iters/s")
+
+    # --- BM25 vs TF-IDF served QPS (the serving A/B) ---
+    import shutil
+    import tempfile as tf
+
+    from page_rank_and_tfidf_using_apache_spark_tpu import serving
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+        Bm25Config,
+        TfidfConfig,
+    )
+
+    with obs.span("bench.corpus"):
+        docs = _corpus()
+    idx_dir = tf.mkdtemp(prefix="bench_workloads_idx_")
+    try:
+        with obs.span("bench.index_build"):
+            tout = run_tfidf(docs, TfidfConfig(vocab_bits=18))
+            serving.save_index(idx_dir, tout, TfidfConfig(vocab_bits=18),
+                               bm25=Bm25Config())
+            index = serving.load_index(idx_dir)
+        n_q = int(os.environ.get("BENCH_AB_QUERIES", 128))
+        queries = [[f"w{rng.zipf(1.3) % 50_000}"
+                    for _ in range(int(rng.integers(2, 5)))]
+                   for _ in range(n_q)]
+        ab: dict = {}
+        for ranker in ("tfidf", "bm25"):
+            scfg = serving.ServeConfig(top_k=10, max_batch=8, cache_size=0)
+            with serving.TfidfServer(index, scfg) as srv:
+                with obs.span("bench.serve_ab", ranker=ranker):
+                    warm = [srv.submit([f"warmonly{i}"], ranker=ranker)
+                            for i in range(16)]
+                    for p in warm:
+                        p.result(60.0)
+                    t0 = time.perf_counter()
+                    pend = [srv.submit(q, ranker=ranker) for q in queries]
+                    for p in pend:
+                        p.result(120.0)
+                    secs = max(time.perf_counter() - t0, 1e-9)
+            ab[ranker] = round(n_q / secs, 2)
+            log(f"[workloads] serve {ranker}: {ab[ranker]} qps")
+        ab["bm25_over_tfidf"] = round(ab["bm25"] / max(ab["tfidf"], 1e-9), 3)
+        out["bm25_vs_tfidf_served_qps"] = ab
+    finally:
+        shutil.rmtree(idx_dir, ignore_errors=True)
+    return out
+
+
 def measure_tfidf_sharded() -> dict:
     """Sharded (multi-device) ingest throughput — the ROADMAP's
     ``tfidf_sharded_tokens_per_sec``, null in every round before this
@@ -851,6 +996,7 @@ def _main(graph_cache: str) -> int:
     tfidf_out = None
     sharded_out = None
     serve_out = None
+    workloads_out = None
     tfidf_record: dict = {}
     if not os.environ.get("BENCH_SKIP_TFIDF"):
         import shutil
@@ -912,6 +1058,10 @@ def _main(graph_cache: str) -> int:
             # Served-QPS (ISSUE 8): warm batched query path vs the naive
             # per-request cold loop, p50/p99 at fixed batch sizes.
             serve_out = _run_child("serve", TFIDF_TIMEOUT_S, child_env)
+            # Dataflow workloads (ISSUE 9): batched PPR, label-prop CC,
+            # and the BM25-vs-TFIDF serving A/B.
+            workloads_out = _run_child("workloads", TFIDF_TIMEOUT_S,
+                                       child_env)
         finally:
             os.unlink(corpus_cache)
             shutil.rmtree(ck_dir, ignore_errors=True)
@@ -936,6 +1086,17 @@ def _main(graph_cache: str) -> int:
         extra["served_qps"] = serve_out["served_qps"]
         extra["serve_naive_qps"] = serve_out.get("naive_qps")
         extra["serve_speedup_vs_naive"] = serve_out.get("speedup_vs_naive")
+    # Always present so rounds are comparable (null = the workloads child
+    # produced no number this round): the ISSUE 9 dataflow-workload
+    # trajectory keys.
+    extra["ppr_batch_queries_per_sec"] = None
+    extra["cc_iters_per_sec"] = None
+    extra["bm25_vs_tfidf_served_qps"] = None
+    if workloads_out:
+        for key in ("ppr_batch_queries_per_sec", "cc_iters_per_sec",
+                    "bm25_vs_tfidf_served_qps"):
+            if workloads_out.get(key) is not None:
+                extra[key] = workloads_out[key]
     # Always present so rounds are comparable: null = the sharded child
     # did not produce a number this round.
     extra["tfidf_sharded_tokens_per_sec"] = None
@@ -1010,6 +1171,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1] == "--serve":
         print(json.dumps(measure_serve()))
+        sys.exit(0)
+    if len(sys.argv) == 2 and sys.argv[1] == "--workloads":
+        print(json.dumps(measure_workloads()))
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1].startswith("--impl="):
         print(json.dumps(measure_impl(sys.argv[1].split("=", 1)[1])))

@@ -654,6 +654,79 @@ def _build_tfidf_score_query_batch() -> Traceable:
     return Traceable(fn=fn, variants=variants, anchor=ops.score_query_batch)
 
 
+# ---------------------------------------------------- dataflow workloads
+
+
+def _build_ppr_batch() -> Traceable:
+    """Batched personalized PageRank: the vmapped fixpoint runner with a
+    [B, n] donated rank carry and [B, n] teleport matrix."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import ppr
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
+
+    n, e, b = 64, 256, 4
+    cfg = PageRankConfig(iterations=4, dangling="redistribute", init="uniform")
+    run = ppr.make_ppr_batch_runner(n, cfg)
+    dg = _device_graph_spec(n, e)
+    return Traceable(
+        fn=run,
+        variants=[("b4-n64", (dg, _f32((b, n)), _f32((b, n))))],
+        anchor=ppr.make_ppr_batch_runner,
+    )
+
+
+def _build_hits() -> Traceable:
+    """HITS: two interleaved SpMV passes (sorted dst combine + unsorted
+    src combine) with per-step max normalization, [2, n] donated carry."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import hits
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import HitsConfig
+
+    n, e = 64, 256
+    run = hits.make_hits_runner(n, HitsConfig(iterations=4, tol=0.0))
+    dg = _device_graph_spec(n, e)
+    return Traceable(
+        fn=run,
+        variants=[("n64", (dg, _f32((2, n))))],
+        anchor=hits.hits_step,
+    )
+
+
+def _build_components() -> Traceable:
+    """Connected components: min-label propagation to fixpoint (while
+    loop, changed-label-count delta), int32 donated label carry."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import components
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+        ComponentsConfig,
+    )
+
+    n, e = 64, 256
+    run = components.make_components_runner(n, ComponentsConfig(iterations=8))
+    dg = _device_graph_spec(n, e)
+    return Traceable(
+        fn=run,
+        variants=[("n64", (dg, _i32((n,))))],
+        anchor=components.label_step,
+    )
+
+
+def _build_bm25_weights() -> Traceable:
+    """BM25 re-weighting of the postings COO: two gathers + elementwise
+    math, one compile per nnz shape (index build time)."""
+    import functools
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import bm25
+
+    nnz, n_docs, vocab = 4096, 16, 1 << 10
+    fn = functools.partial(bm25.bm25_weights, n_docs=n_docs, k1=1.5, b=0.75)
+    return Traceable(
+        fn=fn,
+        variants=[
+            ("nnz4k", (_i32((nnz,)), _i32((nnz,)), _f32((nnz,)),
+                       _i32((n_docs,)), _f32((vocab,))))
+        ],
+        anchor=bm25.bm25_weights,
+    )
+
+
 # ------------------------------------------------------------- the registry
 
 ENTRY_POINTS: tuple[EntryPoint, ...] = (
@@ -661,6 +734,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         name="pagerank_step",
         module=f"{_PKG}/ops/pagerank.py",
         build=_build_pagerank_scan,
+        watch=(f"{_PKG}/dataflow/fixpoint.py",),
         # iterate-to-fixpoint runner: the rank carry (argnum 1 of
         # run(dg, ranks0, e)) is donated — verified against the lowered
         # aliasing by the tier-3 donation check
@@ -671,6 +745,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         name="pagerank_step_tol_cumsum",
         module=f"{_PKG}/ops/pagerank.py",
         build=_build_pagerank_while_cumsum,
+        watch=(f"{_PKG}/dataflow/fixpoint.py",),
         donate=(1,),
         intensity_floor=0.045,  # static model measures 0.054
     ),
@@ -679,7 +754,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         module=f"{_PKG}/ops/pallas_kernels.py",
         build=_build_pagerank_pallas,
         # the runner composes ops/pagerank.py machinery around the kernel
-        watch=(f"{_PKG}/ops/pagerank.py",),
+        watch=(f"{_PKG}/ops/pagerank.py", f"{_PKG}/dataflow/fixpoint.py"),
         donate=(1,),
         intensity_floor=0.04,  # static model measures 0.050
     ),
@@ -687,6 +762,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         name="pagerank_step_hybrid",
         module=f"{_PKG}/ops/pagerank.py",
         build=_build_pagerank_hybrid,
+        watch=(f"{_PKG}/dataflow/fixpoint.py",),
         donate=(1,),
         intensity_floor=0.05,  # static model measures 0.075
     ),
@@ -694,6 +770,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         name="pagerank_step_sort_shuffle",
         module=f"{_PKG}/ops/pagerank.py",
         build=_build_pagerank_sort_shuffle,
+        watch=(f"{_PKG}/dataflow/fixpoint.py",),
         donate=(1,),
         intensity_floor=0.05,  # static model measures 0.072
     ),
@@ -715,6 +792,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         build=_build_pagerank_sharded_edges,
         watch=(
             f"{_PKG}/ops/pagerank.py",
+            f"{_PKG}/dataflow/fixpoint.py",
             f"{_PKG}/parallel/mesh.py",
             f"{_PKG}/parallel/collectives.py",
             f"{_PKG}/parallel/compat.py",
@@ -736,6 +814,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         build=_build_pagerank_sharded_nodes_balanced,
         watch=(
             f"{_PKG}/ops/pagerank.py",
+            f"{_PKG}/dataflow/fixpoint.py",
             f"{_PKG}/parallel/mesh.py",
             f"{_PKG}/parallel/collectives.py",
             f"{_PKG}/parallel/compat.py",
@@ -761,6 +840,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         build=_build_pagerank_sharded_hybrid,
         watch=(
             f"{_PKG}/ops/pagerank.py",
+            f"{_PKG}/dataflow/fixpoint.py",
             f"{_PKG}/parallel/mesh.py",
             f"{_PKG}/parallel/collectives.py",
             f"{_PKG}/parallel/compat.py",
@@ -785,6 +865,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         build=_build_pagerank_sharded_src,
         watch=(
             f"{_PKG}/ops/pagerank.py",
+            f"{_PKG}/dataflow/fixpoint.py",
             f"{_PKG}/parallel/mesh.py",
             f"{_PKG}/parallel/collectives.py",
             f"{_PKG}/parallel/compat.py",
@@ -811,7 +892,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         build=_build_tfidf_chunk_drain,
         # the shape matrix runs through models/tfidf.py grow_chunk_cap —
         # a policy change there must re-verify this contract
-        watch=(f"{_PKG}/models/tfidf.py",),
+        watch=(f"{_PKG}/models/tfidf.py", f"{_PKG}/dataflow/ingest.py"),
         # The doubling cap policy may legally produce a handful of buckets
         # over a whole stream; the declared matrix must collapse to <= 3.
         max_compiles=3,
@@ -826,7 +907,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         name="tfidf_chunk_ingest_carry",
         module=f"{_PKG}/ops/tfidf.py",
         build=_build_tfidf_chunk_ingest_carry,
-        watch=(f"{_PKG}/models/tfidf.py",),
+        watch=(f"{_PKG}/models/tfidf.py", f"{_PKG}/dataflow/ingest.py"),
         max_compiles=3,
         pad_plan=_chunk_pad_plan,
         pad_frac_ceiling=0.20,
@@ -865,6 +946,40 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         intensity_floor=0.04,  # static model measures 0.060
     ),
     EntryPoint(
+        name="dataflow_ppr_batch",
+        module=f"{_PKG}/dataflow/ppr.py",
+        build=_build_ppr_batch,
+        # the step math and the iterate skeleton live under these
+        watch=(f"{_PKG}/ops/pagerank.py", f"{_PKG}/dataflow/fixpoint.py"),
+        # the [B, n] rank carry (argnum 1) is donated, same contract as
+        # the single-query runner
+        donate=(1,),
+        intensity_floor=0.06,  # static model measures 0.086 (b=4)
+    ),
+    EntryPoint(
+        name="dataflow_hits",
+        module=f"{_PKG}/dataflow/hits.py",
+        build=_build_hits,
+        watch=(f"{_PKG}/dataflow/combine.py", f"{_PKG}/dataflow/fixpoint.py"),
+        donate=(1,),
+        intensity_floor=0.04,  # static model measures 0.049
+    ),
+    EntryPoint(
+        name="dataflow_components",
+        module=f"{_PKG}/dataflow/components.py",
+        build=_build_components,
+        watch=(f"{_PKG}/dataflow/combine.py", f"{_PKG}/dataflow/fixpoint.py"),
+        donate=(1,),
+        intensity_floor=0.04,  # static model measures 0.052
+    ),
+    EntryPoint(
+        name="dataflow_bm25_weights",
+        module=f"{_PKG}/dataflow/bm25.py",
+        build=_build_bm25_weights,
+        # pure re-weighting pass: gathers + elementwise over the COO
+        intensity_floor=0.10,  # static model measures 0.122
+    ),
+    EntryPoint(
         name="tfidf_score_query_batch",
         module=f"{_PKG}/ops/tfidf.py",
         build=_build_tfidf_score_query_batch,
@@ -874,6 +989,7 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         watch=(
             f"{_PKG}/serving/server.py",
             f"{_PKG}/models/tfidf.py",
+            f"{_PKG}/dataflow/ingest.py",
         ),
         # one compile per padded batch cap: {1, 2, 4, 8, 16} at
         # max_batch 16 — the full warm set; anything beyond means an

@@ -572,7 +572,9 @@ def check_dce_timed(ctx: FileContext) -> Iterator[Hit]:
 # executor (retry/backoff, sync deadlines, the CPU degradation ladder, and
 # ResilienceExhausted-with-checkpoint).  resilience/ itself is exempt — it
 # is where the raw calls legitimately live.
-_GUARDED_TREE_DIRS = frozenset({"models", "parallel", "io", "serving"})
+_GUARDED_TREE_DIRS = frozenset(
+    {"models", "parallel", "io", "serving", "dataflow"}
+)
 _RAW_SYNC_CALLS = frozenset({"jax.device_get", "jax.block_until_ready"})
 _ASARRAY_CALLS = frozenset(
     {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
@@ -609,7 +611,7 @@ def _device_bound_names(fn: FuncNode | None, ctx: FileContext) -> set[str]:
 @rule(
     "unguarded-host-sync",
     "raw jax.device_get / .block_until_ready() / np.asarray(device value) "
-    "in models/, parallel/, io/ or serving/ — host syncs there must route "
+    "in models/, parallel/, io/, serving/ or dataflow/ — host syncs there must route "
     "through "
     "resilience.executor so retries, sync deadlines and the degradation "
     "ladder apply (ratchet stays at zero: migrate, don't baseline)",
@@ -700,7 +702,7 @@ def _inside_span(node: ast.AST, ctx: FileContext) -> bool:
 @rule(
     "untraced-guarded-site",
     "run_guarded / guarded device_get / block_until_ready call site in "
-    "models/, parallel/, io/ or serving/ outside an active obs.span — the "
+    "models/, parallel/, io/, serving/ or dataflow/ outside an active obs.span — the "
     "resilience "
     "ladder's retry/watchdog/degrade events would land in the trace with "
     "no phase to attribute them to",

@@ -48,6 +48,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-query-terms", type=int, default=16)
     p.add_argument("--cache-size", type=int, default=1024,
                    help="hot-query LRU entries (0 disables)")
+    p.add_argument("--ranker", choices=["tfidf", "bm25"], default="tfidf",
+                   help="default scoring weights per request (the index "
+                        "must bundle BM25 weights for bm25 — cli.tfidf "
+                        "--save-index does by default).  A query line may "
+                        "override per request with an '@tfidf '/'@bm25 ' "
+                        "prefix — the A/B switch.")
     p.add_argument("--rank-alpha", type=float, default=0.0,
                    help="blend the index's PageRank prior into scores "
                         "(score + alpha * rank; needs an index built with "
@@ -90,7 +96,20 @@ def _main(args) -> int:
                 terms = line.split()
                 if not terms:
                     continue
-                pending.append((qid, srv.submit(terms)))
+                ranker = args.ranker
+                if terms[0] in ("@tfidf", "@bm25"):  # per-request A/B
+                    ranker = terms[0][1:]
+                    terms = terms[1:]
+                    if not terms:
+                        continue
+                try:
+                    pending.append((qid, srv.submit(terms, ranker=ranker)))
+                except ValueError as exc:
+                    # one bad line (e.g. '@bm25' against an index without
+                    # BM25 weights) must not kill the serve session —
+                    # report it and keep draining the stream
+                    print(f"query {qid}: {exc}", file=sys.stderr)
+                    continue
                 if interactive:
                     while pending:
                         _drain_one(pending, lat)

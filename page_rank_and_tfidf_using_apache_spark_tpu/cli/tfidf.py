@@ -70,6 +70,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--index-ranks", default=None, metavar="NPY",
                    help="with --save-index: bundle this [n_docs] PageRank "
                         "prior (.npy) into the artifact")
+    p.add_argument("--no-index-bm25", action="store_true",
+                   help="with --save-index: skip bundling the BM25 "
+                        "second-ranker weights (bundled by default — "
+                        "same postings, different weighting; enables "
+                        "cli.serve --ranker bm25 / per-request A/B)")
+    p.add_argument("--bm25-k1", type=float, default=1.5,
+                   help="BM25 k1 (term-frequency saturation; default 1.5)")
+    p.add_argument("--bm25-b", type=float, default=0.75,
+                   help="BM25 b (length normalization; default 0.75)")
     p.add_argument("--query", nargs="+", default=None, metavar="TERM",
                    help="score docs against these terms, print top-k")
     p.add_argument("--top-k", type=int, default=10)
@@ -152,10 +161,16 @@ def _main(args) -> int:
         import numpy as np
 
         from page_rank_and_tfidf_using_apache_spark_tpu.serving import save_index
+        from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+            Bm25Config,
+        )
 
         ranks = np.load(args.index_ranks) if args.index_ranks else None
-        path = save_index(args.save_index, out, cfg, ranks=ranks)
-        print(json.dumps({"index": path}), file=sys.stderr)
+        bm25 = (None if args.no_index_bm25 or out.count is None
+                else Bm25Config(k1=args.bm25_k1, b=args.bm25_b))
+        path = save_index(args.save_index, out, cfg, ranks=ranks, bm25=bm25)
+        print(json.dumps({"index": path, "bm25": bm25 is not None}),
+              file=sys.stderr)
 
     if args.output:
         with open(args.output, "w") as f:

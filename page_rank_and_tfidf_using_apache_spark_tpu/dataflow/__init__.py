@@ -1,0 +1,67 @@
+"""dataflow/ — the RDD-analog core under every workload (ISSUE 9).
+
+What made the reference a *framework* rather than two scripts was Spark's
+RDD layer: one partitioned-collection abstraction with shuffle,
+broadcast-join and iteration, which PageRank and TF-IDF are both thin
+programs over.  This package is that layer's TPU-native analog — a small
+set of JAX-native primitives with the resilience/elastic/obs machinery
+attached ONCE, underneath:
+
+=====================  ====================================================
+Spark RDD operation    dataflow primitive
+=====================  ====================================================
+``partitionBy``        :class:`partition.PartitionedArray` (+ the static
+                       plans in ``parallel.pagerank_sharded.plan_partition``
+                       and the ``ingest.grow_chunk_cap`` padding policy)
+``reduceByKey(op)``    :func:`combine.segment_combine` (add/min/max) and
+                       :func:`combine.graph_combine` (the degree-aware
+                       SpMV shuffle impls)
+``broadcast`` + join   :func:`combine.broadcast_join`
+driver ``for`` loop    :func:`fixpoint.iterate` (in-jit scan/while) +
+                       :func:`fixpoint.run_segments` (host segments with
+                       checkpoints + the elastic degradation ladder)
+``textFile`` ingest    :func:`ingest.chunked_ingest` (bounded source →
+                       padded device chunks, donated carry, commit points)
+=====================  ====================================================
+
+PageRank (single-chip + sharded) and streaming TF-IDF are ported to run
+over these primitives with pinned equivalence to the pre-port paths; the
+marginal-cost claim is demonstrated by the four workloads that open on
+top: batched personalized PageRank (:mod:`ppr`), HITS (:mod:`hits`),
+connected components / label propagation (:mod:`components`) and BM25
+(:mod:`bm25`, served as an A/B-able second ranker beside TF-IDF).  Every
+jit entry point here is registered in ``analysis/registry.py`` so the
+tier-2/3 lint gates cover the subsystem from day one.
+"""
+
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.fixpoint import (
+    ElasticResult,
+    iterate,
+    run_segments,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.ingest import (
+    chunked_ingest,
+    grow_chunk_cap,
+    prefetched,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.combine import (
+    broadcast_join,
+    graph_combine,
+    segment_combine,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.partition import (
+    PartitionedArray,
+)
+
+__all__ = [
+    "ElasticResult",
+    "PartitionedArray",
+    "broadcast_join",
+    "chunked_ingest",
+    "graph_combine",
+    "grow_chunk_cap",
+    "iterate",
+    "prefetched",
+    "run_segments",
+    "segment_combine",
+]

@@ -1,0 +1,73 @@
+"""``segment_combine`` / ``broadcast_join`` — the dataflow shuffle and
+broadcast primitives.
+
+Spark correspondence (SURVEY.md L3, BASELINE.json:5):
+
+- ``reduceByKey(op)`` → :func:`segment_combine` — a segmented reduction
+  over a keyed flat array.  On sorted keys it is one contiguous
+  ``segment_*`` pass (the contract every dst-sorted edge layout in this
+  repo maintains); unsorted keys take the scatter path.  ``op`` extends
+  past Spark's common ``add`` to ``min``/``max`` — the combine of the
+  connected-components / label-propagation workload.
+- the per-iteration SpMV shuffle → :func:`graph_combine` — routes one
+  degree-weighted gather + combine through the *existing* SpMV impls
+  (segment / cumsum / cumsum_mxu / hybrid / sort_shuffle / pallas) and
+  their static degree-aware layouts, so every fixpoint workload shares
+  one tuned shuffle implementation instead of re-owning scatter
+  strategy.
+- ``broadcast(table)`` + map-side join → :func:`broadcast_join` — a
+  device-resident gather of a replicated table (Spark's torrent
+  broadcast is a sharding annotation here; the join is the gather).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def segment_combine(
+    values: jax.Array,
+    keys: jax.Array,
+    num_segments: int,
+    *,
+    op: str = "add",
+    indices_are_sorted: bool = False,
+) -> jax.Array:
+    """``reduceByKey``: combine ``values`` by ``keys`` into
+    ``num_segments`` slots.  Empty segments yield the op's identity for
+    ``add`` (0) and the dtype's extreme for ``min``/``max`` (callers that
+    need a different fill combine against their own initial state — see
+    ``dataflow.components``)."""
+    fns = {
+        "add": jax.ops.segment_sum,
+        "min": jax.ops.segment_min,
+        "max": jax.ops.segment_max,
+    }
+    if op not in fns:
+        raise ValueError(f"unknown combine op {op!r} (want add/min/max)")
+    return fns[op](
+        values, keys, num_segments=num_segments,
+        indices_are_sorted=indices_are_sorted,
+    )
+
+
+def graph_combine(dg, weighted: jax.Array, n: int, impl: str = "segment") -> jax.Array:
+    """The graph-shuffle form of :func:`segment_combine`:
+    ``out[v] = Σ_{(u,v)∈E} weighted[u]`` through whichever SpMV impl (and
+    static layout) the :class:`~..ops.pagerank.DeviceGraph` was built for.
+    This is the hot per-iteration ``join → flatMap → reduceByKey`` chain
+    of BASELINE.json:5 behind ONE dispatch point — PageRank, personalized
+    PageRank and HITS's authority pass all route here."""
+    # ops.pagerank owns the impl table (and imports dataflow.fixpoint);
+    # resolve lazily to keep the package import DAG acyclic.
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+
+    return ops.spmv(dg, weighted, n, impl)
+
+
+def broadcast_join(table: jax.Array, keys: jax.Array) -> jax.Array:
+    """Map-side join against a broadcast table: ``out[i] =
+    table[keys[i]]``.  The reference's ``tf.join(idf)`` (a shuffle in
+    Spark) and the per-edge rank lookup ``ranks[src]`` are both this one
+    gather; on a mesh the table rides replicated, which IS the broadcast."""
+    return table[keys]

@@ -1,0 +1,123 @@
+"""Connected components via min-label propagation (ISSUE 9 workload 3).
+
+The SAME SpMV skeleton as PageRank — gather along edges, combine by
+destination — with the combine swapped from ``add`` to ``min``
+(``dataflow.segment_combine(op="min")``): every node starts labeled with
+its own id, each step every node takes the minimum label over itself and
+its neighbors along BOTH edge directions (a directed edge list describes
+an undirected connectivity question), and the fixpoint is reached when
+no label changes.  The converged label of a node is the smallest node id
+in its weakly-connected component, so components are exactly the label
+classes — pinned against ``networkx.connected_components`` by the oracle
+test.
+
+Convergence is data-dependent (≈ the component diameter), so the loop
+runs as a tolerance fixpoint: the delta gauge is the COUNT of changed
+labels (cast to float for the shared ``iterate`` carry) and ``tol=0.5``
+means "stop when nothing moved".  ``bench.py --workloads`` records
+``cc_iters_per_sec`` over this runner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import combine
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import fixpoint as dflow
+from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
+from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import ComponentsConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+
+
+def label_step(labels, dg: ops.DeviceGraph, n: int):
+    """One min-propagation round over both edge directions.  Empty
+    segments come back as the dtype max from ``segment_min``; the outer
+    ``minimum`` against the current labels clamps them away."""
+    import jax.numpy as jnp
+
+    incoming = combine.segment_combine(
+        combine.broadcast_join(labels, dg.src), dg.dst, n,
+        op="min", indices_are_sorted=True,
+    )
+    outgoing = combine.segment_combine(
+        combine.broadcast_join(labels, dg.dst), dg.src, n,
+        op="min", indices_are_sorted=False,
+    )
+    return jnp.minimum(labels, jnp.minimum(incoming, outgoing))
+
+
+def make_components_runner(n: int, cfg: ComponentsConfig):
+    """Compile the label-propagation fixpoint: ``run(dg, labels0 [n]
+    int32) -> (labels, iters, changed)``, labels donated (argnum 1)."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def run(dg: ops.DeviceGraph, labels0: jax.Array):
+        return dflow.iterate(
+            lambda lab: label_step(lab, dg, n), labels0,
+            iterations=cfg.iterations, tol=cfg.tol,
+            delta_fn=lambda new, old: jnp.sum(
+                (new != old).astype(jnp.float32)
+            ),
+        )
+
+    return run
+
+
+@dataclasses.dataclass(frozen=True)
+class ComponentsResult:
+    labels: np.ndarray  # int32 [n]: smallest node id in the component
+    n_components: int
+    iterations: int
+    metrics: MetricsRecorder
+    # False when the iteration cap ended the run with labels still
+    # changing: the component split is then an OVER-segmentation (a long
+    # chain needs ~diameter rounds) — callers must not trust
+    # n_components without checking this.
+    converged: bool = True
+
+    def groups(self) -> list[set[int]]:
+        """Components as sets of compacted node indices (oracle-test
+        shape, mirroring networkx.connected_components)."""
+        out: dict[int, set[int]] = {}
+        for i, lab in enumerate(self.labels):
+            out.setdefault(int(lab), set()).add(i)
+        return list(out.values())
+
+
+def run_components(
+    graph: Graph,
+    cfg: ComponentsConfig = ComponentsConfig(),
+    *,
+    metrics: MetricsRecorder | None = None,
+) -> ComponentsResult:
+    """Weakly-connected components of the edge list, to fixpoint."""
+    metrics = metrics or MetricsRecorder()
+    n = graph.n_nodes
+    if n == 0:
+        return ComponentsResult(np.zeros(0, np.int32), 0, 0, metrics)
+
+    labels, done, last_changed = dflow.run_single_chip_fixpoint(
+        cfg, metrics, site_prefix="cc",
+        init_state=lambda: np.arange(n, dtype=np.int32),
+        make_runner=lambda seg_cfg: make_components_runner(n, seg_cfg),
+        build_operands=lambda: (ops.put_graph(graph, "float32"),),
+        call=lambda runner, ops_t, ld: runner(ops_t[0], ld),
+    )
+    # last_changed is the final round's changed-label COUNT: nonzero past
+    # the iteration cap means labels were still propagating and the
+    # grouping below over-segments long components — surface it loudly.
+    converged = last_changed <= cfg.tol
+    if not converged:
+        metrics.record(event="cc_not_converged", iterations=done,
+                       still_changing=int(last_changed))
+    n_components = int(np.unique(labels).shape[0])
+    metrics.scalar("n_components", n_components)
+    return ComponentsResult(labels=labels, n_components=n_components,
+                            iterations=done, metrics=metrics,
+                            converged=converged)

@@ -1,0 +1,115 @@
+"""HITS (hubs & authorities) — two interleaved SpMV fixpoints with norm
+steps (ISSUE 9 workload 2; Kleinberg's algorithm, networkx-parity
+semantics).
+
+Per iteration, mirroring ``networkx.hits`` exactly so the oracle test
+can pin values, not just ordering:
+
+1. ``auth[v] = Σ_{(u,v)∈E} hub[u]`` — the forward SpMV, the SAME
+   dst-sorted segment combine PageRank's contribution pass uses;
+2. ``auth /= max(auth)``;
+3. ``hub[u] = Σ_{(u,v)∈E} auth[v]`` — the *reverse* SpMV, a
+   ``dataflow.segment_combine`` over the src axis (unsorted scatter-add:
+   the edge array is dst-sorted, and HITS is the first workload that
+   reduces along the other axis);
+4. ``hub /= max(hub)``;
+5. converge on the L1 delta of the hub vector; final sum-normalization
+   of both vectors.
+
+Both vectors ride one ``[2, n]`` carry through a single
+:func:`dataflow.fixpoint.iterate` loop (donated, same contract as the
+PageRank runners), and the host side is the shared segment driver —
+checkpoints, retry and CPU degradation included, zero new wiring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import combine
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import fixpoint as dflow
+from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
+from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import config
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import HitsConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+
+
+def hits_step(ha, dg: ops.DeviceGraph, n: int):
+    """One networkx-parity HITS iteration over the ``[2, n]`` carry
+    (row 0 = hubs, row 1 = authorities)."""
+    import jax.numpy as jnp
+
+    hub = ha[0]
+    auth = combine.segment_combine(
+        combine.broadcast_join(hub, dg.src), dg.dst, n,
+        op="add", indices_are_sorted=True,
+    )
+    auth = auth / jnp.maximum(jnp.max(auth), 1e-30)
+    new_hub = combine.segment_combine(
+        combine.broadcast_join(auth, dg.dst), dg.src, n,
+        op="add", indices_are_sorted=False,
+    )
+    new_hub = new_hub / jnp.maximum(jnp.max(new_hub), 1e-30)
+    return jnp.stack([new_hub, auth])
+
+
+def make_hits_runner(n: int, cfg: HitsConfig):
+    """Compile the HITS fixpoint: ``run(dg, ha0 [2, n]) -> (ha, iters,
+    delta)`` with the carry donated (argnum 1) and convergence on the hub
+    vector's L1 delta (networkx's ``err`` gauge)."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def run(dg: ops.DeviceGraph, ha0: jax.Array):
+        return dflow.iterate(
+            lambda ha: hits_step(ha, dg, n), ha0,
+            iterations=cfg.iterations, tol=cfg.tol,
+            delta_fn=lambda new, old: jnp.sum(jnp.abs(new[0] - old[0])),
+        )
+
+    return run
+
+
+@dataclasses.dataclass(frozen=True)
+class HitsResult:
+    hubs: np.ndarray  # f[n], sum-normalized
+    authorities: np.ndarray  # f[n], sum-normalized
+    iterations: int
+    l1_delta: float
+    metrics: MetricsRecorder
+
+
+def run_hits(
+    graph: Graph,
+    cfg: HitsConfig = HitsConfig(),
+    *,
+    metrics: MetricsRecorder | None = None,
+) -> HitsResult:
+    """Run HITS to convergence on the default device.  All host-loop
+    machinery (segments, checkpoints of the [2, n] carry, retry + CPU
+    rung) comes from the shared dataflow fixpoint driver."""
+    config.ensure_dtype_support(cfg.dtype)
+    metrics = metrics or MetricsRecorder()
+    n = graph.n_nodes
+    if n == 0:
+        z = np.zeros(0, cfg.dtype)
+        return HitsResult(z, z, 0, 0.0, metrics)
+
+    ha, done, last_delta = dflow.run_single_chip_fixpoint(
+        cfg, metrics, site_prefix="hits",
+        init_state=lambda: np.full((2, n), 1.0 / n, cfg.dtype),
+        make_runner=lambda seg_cfg: make_hits_runner(n, seg_cfg),
+        build_operands=lambda: (ops.put_graph(graph, cfg.dtype),),
+        call=lambda runner, ops_t, hd: runner(ops_t[0], hd),
+    )
+    hubs, auths = ha[0], ha[1]
+    hs, as_ = float(hubs.sum()), float(auths.sum())
+    hubs = hubs / hs if hs > 0 else hubs
+    auths = auths / as_ if as_ > 0 else auths
+    return HitsResult(hubs=hubs, authorities=auths, iterations=done,
+                      l1_delta=last_delta, metrics=metrics)

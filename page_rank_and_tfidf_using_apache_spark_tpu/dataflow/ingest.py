@@ -1,0 +1,159 @@
+"""``chunked_ingest`` — the dataflow core's bounded-source ingest primitive.
+
+Spark correspondence: reading a partitioned input (``textFile`` →
+per-partition iterator chains) under a driver that tracks progress.  The
+TPU-native shape (SURVEY.md §5.7): a bounded host source feeding
+fixed-capacity padded device chunks through a once-compiled kernel, with
+a donated device-resident carry, bounded in-flight launches, and commit
+points (checkpoints) that only ever snapshot fully-drained state.
+
+This module owns the three pieces every ingest path shares — the
+:func:`grow_chunk_cap` fixed-shape padding policy (moved here from
+``models/tfidf.py``, which re-exports it; the serving micro-batcher rides
+the same policy at ``min_bits=0``), the :func:`prefetched` background-
+thread source buffer, and the :func:`chunked_ingest` pipeline driver —
+so the streaming TF-IDF path in ``models/tfidf.py`` is now a thin
+program over this primitive (launch/drain/commit closures only), and the
+next chunked workload starts from the same wiring instead of copying the
+deque discipline.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Callable, Iterable, Iterator
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+
+
+def grow_chunk_cap(
+    need: int, cap: int, metrics: MetricsRecorder, *, min_bits: int = 10,
+    **context
+) -> tuple[int, bool]:
+    """Fixed-shape capacity policy, shared by the streaming/sharded ingest
+    paths AND the serving micro-batcher: power-of-two start (at least
+    ``2**min_bits`` — the ingest default of 10 keeps token chunks
+    kernel-sized; the serving batcher passes 0 so a batch of 3 pads to 4,
+    not 1024), doubling bumps (each bump is a logged recompile —
+    SURVEY.md §7 'fixed shapes under jit').  Returns (cap, changed)."""
+    changed = False
+    if cap <= 0:
+        cap = 1 << max(min_bits, int(np.ceil(np.log2(max(need, 1)))))
+        changed = True
+    while need > cap:
+        cap *= 2
+        changed = True
+        metrics.record(event="chunk_cap_bump", cap=cap, **context)
+    return cap, changed
+
+
+_QUEUE_END = object()
+
+
+def prefetched(source: Iterator, depth: int) -> Iterator:
+    """Run ``source`` on a background thread, buffering up to ``depth``
+    items (SURVEY.md §5.7 double-buffered ingest).  Tokenizing is host
+    C++/numpy that releases the GIL, so it genuinely overlaps the XLA chunk
+    kernel.  Exceptions are forwarded and re-raised on the consumer side;
+    if the consumer abandons the generator (exception or early close), the
+    producer notices via a stop event and exits instead of blocking forever
+    on a full queue."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer() -> None:
+        try:
+            for item in source:
+                if not put(item):
+                    return
+        except BaseException as exc:  # noqa: BLE001 — forwarded to consumer
+            put(exc)
+        else:
+            put(_QUEUE_END)
+
+    thread = threading.Thread(target=producer, name="ingest-source",
+                              daemon=True)
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _QUEUE_END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        thread.join()
+
+
+def chunked_ingest(
+    source: Iterable,
+    *,
+    launch: Callable,
+    drain: Callable,
+    commit: Callable[[], None],
+    depth: int = 0,
+    checkpoint_due: Callable[[], bool] | None = None,
+    save_checkpoint: Callable[[], None] | None = None,
+    prefetch_source: bool = True,
+) -> None:
+    """Drive a bounded source through a launch/drain pipeline with commit
+    points — the host half of the streaming ingest, shared wiring for the
+    resilience/checkpoint discipline:
+
+    - ``launch(item)`` dispatches one chunk (async) and returns an
+      in-flight record; up to ``depth`` launches stay in flight before
+      the oldest is drained (``depth == 0`` is fully serial).
+    - ``drain(record)`` completes one launch (the guarded host pull —
+      sites/spans belong to the caller's closure).
+    - ``commit()`` pulls carry state the kernel accumulates on device
+      (e.g. the donated DF carry).  Called only when NOTHING is in
+      flight — a snapshot must never hold contributions from chunks it
+      does not record as ingested — and once at the end.
+    - ``checkpoint_due()`` / ``save_checkpoint()``: when due, the
+      pipeline drains everything in flight, commits, then snapshots.
+
+    With ``prefetch_source=True`` and ``depth > 0`` the source iterator
+    additionally runs on a background thread (:func:`prefetched`), so
+    host-side chunk preparation overlaps device compute.
+    """
+    depth = max(int(depth), 0)
+    it: Iterable = source
+    if prefetch_source and depth > 0:
+        it = prefetched(iter(source), depth)
+
+    inflight: collections.deque = collections.deque()
+
+    def maybe_checkpoint() -> None:
+        if checkpoint_due is None or save_checkpoint is None:
+            return
+        if not checkpoint_due():
+            return
+        while inflight:  # drain to the commit point
+            drain(inflight.popleft())
+        commit()
+        save_checkpoint()
+
+    for item in it:
+        inflight.append(launch(item))
+        while len(inflight) > depth:
+            drain(inflight.popleft())
+        maybe_checkpoint()
+    while inflight:
+        drain(inflight.popleft())
+        maybe_checkpoint()
+    commit()

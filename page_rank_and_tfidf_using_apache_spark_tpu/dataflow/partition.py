@@ -1,0 +1,89 @@
+"""``PartitionedArray`` — the dataflow core's partitioned-collection type.
+
+The RDD analog (SURVEY.md L3 ``partitionBy``): ONE logical global array
+plus the layout bookkeeping that maps it onto devices — padded length,
+the global-id → padded-slot relabeling a partition strategy chose
+(``parallel.pagerank_sharded.plan_partition``), and the mesh sharding the
+device value carries.  Callers program against the logical view; the
+padding/relabeling round-trip lives here once instead of inside each
+runner (``_ShardedExec.put_ranks`` / ``extract_np`` are thin calls now).
+
+The host→device direction pads and places; the device→host direction is
+a *guarded* pull (resilience executor: retry / sync deadline /
+degradation ladder) returning the logical array — so every workload that
+states its results through a ``PartitionedArray`` inherits the repo's
+host-sync discipline for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionedArray:
+    """A logical [n] array laid out as a padded (optionally sharded)
+    device value of length ``n_pad``, with ``node_map[global_id] ->
+    padded slot``.  ``sharding=None`` is the single-chip identity layout
+    (n_pad == n, map == arange)."""
+
+    n: int
+    n_pad: int
+    node_map: np.ndarray  # int64 [n]: global id -> padded slot
+    value: Any = None  # device array [n_pad] (None until .put)
+    sharding: Any = None  # jax.sharding.NamedSharding | None
+
+    @classmethod
+    def identity(cls, n: int) -> "PartitionedArray":
+        """Single-chip layout: no padding, no relabeling."""
+        return cls(n=n, n_pad=n, node_map=np.arange(n, dtype=np.int64))
+
+    @classmethod
+    def from_plan(cls, n: int, n_pad: int, node_map: np.ndarray,
+                  sharding: Any = None) -> "PartitionedArray":
+        """Layout from a partition plan's bookkeeping (the sharded
+        runners pass ``ShardedGraph.n/n_pad/node_map`` + their state
+        sharding)."""
+        return cls(n=n, n_pad=n_pad, node_map=node_map, sharding=sharding)
+
+    def put(self, global_np: np.ndarray, dtype=None) -> "PartitionedArray":
+        """Pad + relabel + device_put a logical [n] host array; returns a
+        new PartitionedArray holding the device value."""
+        import jax
+
+        dtype = dtype or global_np.dtype
+        if self.n_pad == self.n and self.node_map.shape[0] == self.n and (
+            self.node_map == np.arange(self.n)
+        ).all():
+            padded = np.asarray(global_np, dtype)
+        else:
+            padded = np.zeros(self.n_pad, dtype)
+            padded[self.node_map] = global_np
+        dev = (jax.device_put(padded, self.sharding)
+               if self.sharding is not None else jax.device_put(padded))
+        return dataclasses.replace(self, value=dev)
+
+    def with_value(self, value: Any) -> "PartitionedArray":
+        """The same layout around a new device value (a fixpoint's output
+        carry keeps the input's partition plan)."""
+        return dataclasses.replace(self, value=value)
+
+    def pull(self, *, site: str = "partitioned_pull", metrics=None,
+             checkpoint_dir: str | None = None) -> np.ndarray:
+        """Guarded device→host pull of the LOGICAL array: one batched
+        transfer through the resilience executor, then the node_map
+        inverse on host."""
+        if self.value is None:
+            raise ValueError("PartitionedArray holds no device value")
+        with obs.span("dataflow.pull", site=site, n=self.n):
+            padded = rx.device_get(
+                self.value, site=site, metrics=metrics,
+                checkpoint_dir=checkpoint_dir,
+            )
+        return padded[self.node_map]

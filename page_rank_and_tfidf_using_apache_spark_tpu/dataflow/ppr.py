@@ -1,0 +1,150 @@
+"""Batched personalized PageRank — query-dependent teleport vectors along
+a new vmap axis (ISSUE 9 workload 1; ROADMAP "personalized / weighted
+PageRank ... batched along a new vmap axis").
+
+The single-query path has existed since the seed (``PageRankConfig
+.personalize`` → a concentrated restart vector), but it prices one query
+at one full power iteration.  The serving-shaped workload is a *batch*
+of queries (one personalization set per user/session) over ONE device-
+resident graph: here the whole batch rides a ``jax.vmap`` axis over the
+same :func:`ops.pagerank.pagerank_step` — the graph arrays are closed
+over un-batched (broadcast, not copied), only the ``[B, n]`` rank carry
+and ``[B, n]`` teleport matrix carry the query axis — and the fixpoint
+is ONE compiled :func:`dataflow.fixpoint.iterate` loop whose convergence
+gauge is the *worst* query's L1 delta, so the batch stops when every
+query has.
+
+Marginal-cost receipts: this module contains no shuffle, no scatter
+strategy, no checkpoint/elastic/obs wiring of its own — the SpMV comes
+from the shared impls (``cfg.spmv_impl``, including the degree-aware
+hybrid layout), the host loop from ``dataflow.fixpoint.run_segments``
+(checkpoints + retry + CPU degradation attached there, once), and
+``bench.py --workloads`` records ``ppr_batch_queries_per_sec`` over it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Sequence
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import fixpoint as dflow
+from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
+from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
+from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import put_graph_for
+from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import config
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    PageRankConfig,
+    RankInit,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+
+
+def make_ppr_batch_runner(n: int, cfg: PageRankConfig):
+    """Compile the batched-fixpoint loop: ``run(dg, ranks0 [B, n],
+    e_batch [B, n]) -> (ranks [B, n], iters, delta)``.
+
+    The ``[B, n]`` rank carry is **donated** (argnum 1), same contract as
+    the single-query runner; ``delta`` is the max-over-queries L1 step
+    delta, so a tolerance run ends only when the slowest query converged.
+    One compile serves every batch of the same B (the batch axis is a
+    shape, not a program).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    damping = cfg.damping
+    impl = cfg.spmv_impl
+    dangling = cfg.dangling
+    total_mass = float(n) if cfg.init is RankInit.ONE else 1.0
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def run(dg: ops.DeviceGraph, ranks0: jax.Array, e_batch: jax.Array):
+        step_one = jax.vmap(
+            lambda r, e: ops.pagerank_step(
+                r, dg, e, n=n, damping=damping, dangling=dangling,
+                total_mass=total_mass, impl=impl,
+            )
+        )
+        return dflow.iterate(
+            lambda rb: step_one(rb, e_batch), ranks0,
+            iterations=cfg.iterations, tol=cfg.tol,
+            delta_fn=lambda new, old: jnp.max(
+                jnp.sum(jnp.abs(new - old), axis=1)
+            ),
+        )
+
+    return run
+
+
+def restart_batch(
+    graph: Graph, cfg: PageRankConfig, queries: Sequence[Sequence[int]]
+) -> np.ndarray:
+    """[B, n] teleport matrix: one personalized restart vector per query
+    (original node ids, resolved through the same compaction mapping the
+    single-query path uses)."""
+    rows = []
+    for q in queries:
+        q_cfg = driver.resolve_personalize(
+            graph, dataclasses.replace(cfg, personalize=tuple(int(x) for x in q))
+        )
+        rows.append(ops.restart_vector(graph.n_nodes, q_cfg))
+    return np.stack(rows)
+
+
+@dataclasses.dataclass(frozen=True)
+class PprBatchResult:
+    ranks: np.ndarray  # f[B, n_nodes]
+    iterations: int
+    l1_delta: float  # worst query's final L1 step delta
+    metrics: MetricsRecorder
+
+
+def run_ppr_batch(
+    graph: Graph,
+    cfg: PageRankConfig,
+    queries: Sequence[Sequence[int]],
+    *,
+    metrics: MetricsRecorder | None = None,
+) -> PprBatchResult:
+    """Run one batch of personalized PageRank queries to convergence.
+
+    ``cfg.personalize`` must stay None — the per-query sets arrive in
+    ``queries`` (original node ids).  Checkpointing/segments, retries and
+    the CPU degradation rung all come from the shared dataflow fixpoint
+    driver; the checkpoint payload is the ``[B, n]`` rank matrix.
+    """
+    config.ensure_dtype_support(cfg.dtype)
+    if cfg.personalize is not None:
+        raise ValueError("run_ppr_batch takes queries=, not cfg.personalize")
+    if cfg.spark_exact:
+        raise ValueError("spark_exact cannot be personalized")
+    if not queries:
+        raise ValueError("need at least one personalization query")
+    metrics = metrics or MetricsRecorder()
+    import jax
+
+    n = graph.n_nodes
+    e_host = restart_batch(graph, cfg, queries)  # host copy: salvage source
+    b = len(queries)
+    metrics.record(event="ppr_batch", queries=b, nodes=n)
+
+    # The whole host loop — guarded delta sync, checkpoint segments, CPU
+    # degradation and the elastic salvage rung — is the shared dataflow
+    # driver; this workload only supplies its operands and call shape.
+    ranks_np, done, last_delta = dflow.run_single_chip_fixpoint(
+        cfg, metrics, site_prefix="ppr",
+        init_state=lambda: np.broadcast_to(
+            ops.init_ranks(n, cfg), (b, n)
+        ).copy(),
+        make_runner=lambda seg_cfg: make_ppr_batch_runner(n, seg_cfg),
+        build_operands=lambda: (
+            put_graph_for(graph, cfg), jax.device_put(e_host)
+        ),
+        call=lambda runner, ops_t, rd: runner(ops_t[0], rd, ops_t[1]),
+    )
+    return PprBatchResult(ranks=ranks_np, iterations=done,
+                          l1_delta=last_delta, metrics=metrics)

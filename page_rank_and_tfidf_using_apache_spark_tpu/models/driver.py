@@ -1,25 +1,28 @@
-"""Shared host-side segment driver for the PageRank runners.
+"""Shared host-side helpers for the PageRank model drivers.
 
-Both the single-chip (models/pagerank.py) and sharded
-(parallel/pagerank_sharded.py) paths execute the same host loop: run the
-compiled iteration program in segments, snapshot state between segments,
-stop early on tolerance.  The loop lives here once so checkpoint/convergence
-fixes cannot diverge between the two drivers.
+The segment loop itself — run the compiled iteration program in
+checkpoint-sized segments with the resilience ladder attached — moved to
+the dataflow core (``dataflow/fixpoint.py``: it is the host half of the
+``fixpoint`` primitive, shared by PageRank and every new fixpoint
+workload); :func:`run_segments` and :class:`ElasticResult` are re-exported
+here unchanged for the existing call sites.  What remains native to this
+module is PageRank-driver bookkeeping: personalize-id resolution and
+checkpoint resume.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import os
-from typing import Callable, NamedTuple
 
 import numpy as np
 
-from page_rank_and_tfidf_using_apache_spark_tpu import obs
-from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.fixpoint import (  # noqa: F401 — re-exported API
+    ElasticResult,
+    run_segments,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
 
 
 def resolve_personalize(graph, cfg: PageRankConfig) -> PageRankConfig:
@@ -66,157 +69,3 @@ def resume_from_checkpoint(
     ranks_np[:n] = saved
     metrics.record(event="resume", path=latest, start_iter=start_iter)
     return start_iter
-
-
-class ElasticResult(NamedTuple):
-    """What an elastic shrink handler returns after it rebuilt the mesh
-    and ran the failed segment on the survivors: the segment outputs plus
-    the replacement callables every *subsequent* segment must use."""
-
-    ranks_dev: object
-    iters: int  # effective NEW iterations relative to the pre-failure count
-    delta: float
-    make_runner: Callable
-    invoke: Callable
-    extract_np: Callable
-    metrics_extra: dict  # merged into per-segment metrics (e.g. devices=N)
-
-
-def run_segments(
-    cfg: PageRankConfig,
-    metrics: MetricsRecorder,
-    ranks_dev,
-    start_iter: int,
-    *,
-    make_runner: Callable[[PageRankConfig], Callable],
-    invoke: Callable,
-    extract_np: Callable[[object], np.ndarray],
-    segments_allowed: bool = True,
-    extra_metrics: dict | None = None,
-    make_cpu_invoke: Callable[[PageRankConfig], Callable] | None = None,
-    elastic_rebuild: Callable | None = None,
-):
-    """Run ``cfg.iterations`` in checkpoint-sized compiled segments.
-
-    - ``make_runner(seg_cfg)`` compiles the loop for one segment length;
-      called at most twice (body segments + tail) thanks to caching here.
-    - ``invoke(runner, ranks_dev)`` executes and returns
-      ``(ranks_dev, iters_done, delta)`` with a completed host sync.
-    - ``extract_np(ranks_dev)`` yields the checkpointable rank array.
-    - ``make_cpu_invoke(seg_cfg)``, when given, builds the degradation-
-      ladder rung: a ``ranks_dev -> (ranks_dev, iters, delta)`` callable
-      re-lowered for the CPU backend, run when on-device retries are
-      exhausted or the device is lost.
-    - ``elastic_rebuild(exc, ranks_dev, done, seg_cfg)``, when given, is
-      the mesh-shrink rung for sharded runners: on device loss it salvages
-      the current state, rebuilds the mesh over the surviving devices,
-      repartitions, runs the failed segment there, and returns an
-      :class:`ElasticResult` whose callables replace this loop's (the
-      runner cache is dropped — every compiled program was welded to the
-      dead mesh).  It raises when it does not apply (not a device loss,
-      elastic disabled, nothing survives), passing the ladder on.
-
-    Each segment dispatch runs under the resilience executor: transient
-    failures retry with backoff (the runner is functional, so re-invoking
-    with the same ranks cannot double-apply iterations), persistent ones
-    walk the rungs above, and exhaustion raises ``ResilienceExhausted``
-    carrying the latest checkpoint under ``cfg.checkpoint_dir``.  The
-    single-chip runners *donate* their rank carry (ops/pagerank.py), so
-    ``invoke`` must never let a post-dispatch sync failure reach this
-    site's retry (which would re-dispatch into the consumed buffer):
-    models/pagerank.py fetches the delta through its own guarded site
-    (``pagerank_delta_sync``) whose retries re-pull against live OUTPUT
-    buffers, and an exhausted inner fetch is non-transient here — it
-    walks the rungs, and a rung that cannot read the consumed carry
-    raises onward until ``ResilienceExhausted`` hands the caller the
-    latest checkpoint.  This site's own transient failures (chaos fires
-    at attempt start, before dispatch) still retry with the carry
-    intact.
-
-    Checkpoints are tagged with the segment's ``extra_metrics`` (the
-    sharded runners put ``devices=N`` there), so a snapshot records which
-    mesh shape wrote it — while staying readable across shrinks, because
-    the payload is always the logical ``n`` ranks.
-
-    Returns ``(ranks_dev, done, last_delta)``.
-    """
-    segment = (
-        cfg.checkpoint_every
-        if (cfg.checkpoint_every > 0 and cfg.tol == 0.0 and segments_allowed)
-        else cfg.iterations - start_iter
-    )
-    # GRAFT_SYNC_DEADLINE_S guards *host syncs*, whose healthy duration is
-    # bounded; a compiled segment's legitimate runtime scales with its
-    # iteration count, so inheriting the sync deadline here would kill
-    # healthy long segments.  The dispatch site gets its own knob
-    # (GRAFT_STEP_DEADLINE_S, default 0 = no watchdog).
-    policy = dataclasses.replace(
-        rx.RetryPolicy.from_env(),
-        deadline_s=float(os.environ.get("GRAFT_STEP_DEADLINE_S", 0.0)),
-    )
-    runners: dict[int, Callable] = {}
-    cpu_invokes: dict[int, Callable] = {}
-    done = start_iter
-    last_delta = float("inf")
-    while done < cfg.iterations:
-        todo = min(segment, cfg.iterations - done)
-        seg_cfg = dataclasses.replace(
-            cfg, iterations=todo, checkpoint_every=0, checkpoint_dir=None
-        )
-        if todo not in runners:
-            runners[todo] = make_runner(seg_cfg)
-        rungs: list = []
-        if elastic_rebuild is not None:
-            def elastic_rung(exc, seg_cfg=seg_cfg, rd=ranks_dev):
-                # salvage + shrink + rerun happen in the handler; here we
-                # only swap this loop onto the rebuilt execution context
-                nonlocal make_runner, invoke, extract_np, extra_metrics
-                res: ElasticResult = elastic_rebuild(exc, rd, done, seg_cfg)
-                make_runner, invoke, extract_np = (
-                    res.make_runner, res.invoke, res.extract_np
-                )
-                extra_metrics = {**(extra_metrics or {}), **res.metrics_extra}
-                runners.clear()  # every cached program targeted the old mesh
-                cpu_invokes.clear()
-                return res.ranks_dev, res.iters, res.delta
-
-            rungs.append((None, elastic_rung))
-        if make_cpu_invoke is not None:
-            def cpu_rung(_exc, todo=todo, seg_cfg=seg_cfg, rd=ranks_dev):
-                if todo not in cpu_invokes:
-                    cpu_invokes[todo] = make_cpu_invoke(seg_cfg)
-                return cpu_invokes[todo](rd)
-
-            rungs.append(("cpu", cpu_rung))
-        with Timer() as t, obs.span("pagerank.segment", start=done, todo=todo):
-            ranks_dev, iters, delta = rx.run_guarded(
-                lambda r=runners[todo], rd=ranks_dev: invoke(r, rd),
-                site="pagerank_step", policy=policy, metrics=metrics,
-                checkpoint_dir=cfg.checkpoint_dir, fallbacks=rungs,
-            )
-        done += int(iters)
-        last_delta = float(delta)
-        obs.histogram("pagerank.segment_secs", t.elapsed)
-        metrics.record(
-            iter=done,
-            l1_delta=last_delta,
-            secs=t.elapsed,
-            iters_per_sec=int(iters) / t.elapsed if t.elapsed > 0 else float("inf"),
-            **(extra_metrics or {}),
-        )
-        if cfg.checkpoint_every > 0 and cfg.checkpoint_dir and done < cfg.iterations:
-            with obs.span("pagerank.checkpoint", iter=done):
-                path = ckpt.save_checkpoint(
-                    cfg.checkpoint_dir, done,
-                    {"ranks": extract_np(ranks_dev)}, cfg.config_hash(),
-                    extra=dict(extra_metrics or {}),
-                )
-            metrics.record(event="checkpoint", path=path, iter=done)
-        if cfg.tol > 0.0:
-            # the while_loop runner handled tolerance in-program; one
-            # segment is the whole run
-            break
-
-    metrics.scalar("iterations", done)
-    metrics.scalar("l1_delta", last_delta)
-    return ranks_dev, done, last_delta

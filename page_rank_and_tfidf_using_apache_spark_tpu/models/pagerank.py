@@ -22,6 +22,7 @@ import jax
 import numpy as np
 
 from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import fixpoint as dflow
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
 from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
@@ -132,6 +133,50 @@ def run_pagerank(
                 checkpoint_dir=cfg.checkpoint_dir,
             )
 
+    def init_state() -> np.ndarray:
+        return np.asarray(ops.init_ranks(n, cfg))
+
+    def cpu_exec(seg_cfg, ranks_g: np.ndarray):
+        """Re-lower on the CPU backend from HOST state (graph re-put, no
+        read of any dead device buffer) and run ``seg_cfg.iterations``."""
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            dg_cpu = put_graph_for(graph, cfg)
+            e_cpu = jax.device_put(np.asarray(ops.restart_vector(n, cfg)), cpu)
+            rd_cpu = jax.device_put(ranks_g.astype(cfg.dtype), cpu)
+            runner = make(n, seg_cfg)
+            rd2, iters, delta = runner(dg_cpu, rd_cpu, e_cpu)
+            return rd2, int(iters), float(delta), dg_cpu, e_cpu
+
+    def cpu_salvage_exec(rerun_cfg, ranks_g: np.ndarray):
+        """dataflow.fixpoint.make_cpu_salvage contract: CPU re-lowering +
+        rerun from host state, returning the replacement invoke."""
+        rd2, iters, delta, dg_cpu, e_cpu = cpu_exec(rerun_cfg, ranks_g)
+
+        def cpu_invoke2(runner, rd):
+            rd, iters, delta = runner(dg_cpu, rd, e_cpu)
+            with obs.span("pagerank.delta_sync"):
+                delta = float(rx.device_get(
+                    delta, site="pagerank_delta_sync", metrics=metrics,
+                    checkpoint_dir=cfg.checkpoint_dir,
+                ))
+            return rd, iters, delta
+
+        return rd2, iters, delta, cpu_invoke2
+
+    # The single-chip elastic salvage rung (carried-forward ISSUE 9
+    # satellite): a device-attributed loss first surfacing at the delta
+    # sync, checkpoint pull or result pull used to dead-end — the CPU
+    # rung re-*pulled* the dead/donated carry and failed with it.  The
+    # rung is the SHARED dataflow one: salvage newest snapshot, rerun the
+    # uncommitted span on the CPU backend, swap the loop onto CPU
+    # execution.  Whole-backend faults keep the legacy cpu rung.
+    elastic_salvage = dflow.make_cpu_salvage(
+        cfg, metrics, site_prefix="pagerank",
+        init_state=init_state, cpu_exec=cpu_salvage_exec,
+        make_runner=lambda c: make(n, c), extract_np=extract_np,
+    )
+
     ranks_dev, done, last_delta = driver.run_segments(
         cfg, metrics, ranks_dev, start_iter,
         make_runner=lambda seg_cfg: make(n, seg_cfg),
@@ -139,11 +184,21 @@ def run_pagerank(
         extract_np=extract_np,
         segments_allowed=not cfg.spark_exact,
         make_cpu_invoke=make_cpu_invoke,
+        elastic_rebuild=elastic_salvage,
     )
+
     with obs.span("pagerank.result_pull"):
+        # Device loss first surfacing at the RESULT pull walks the same
+        # shared salvage rung (checkpoint → CPU re-run of the uncommitted
+        # span → pull from the CPU buffers).
         ranks_np = rx.device_get(
             ranks_dev, site="pagerank_result_pull", metrics=metrics,
             checkpoint_dir=cfg.checkpoint_dir,
+            fallbacks=[(None, dflow.make_pull_salvage(
+                cfg, metrics, site_prefix="pagerank",
+                init_state=init_state, cpu_exec=cpu_salvage_exec,
+                get_done=lambda: done,
+            ))],
         )
     return PageRankResult(
         ranks=ranks_np, iterations=done, l1_delta=last_delta, metrics=metrics

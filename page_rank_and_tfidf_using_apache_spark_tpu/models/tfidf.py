@@ -16,10 +16,7 @@ snapshotted atomically; resume skips already-ingested chunks.
 
 from __future__ import annotations
 
-import collections
 import dataclasses
-import queue
-import threading
 import time
 from typing import Iterable, Iterator, Sequence
 
@@ -28,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import ingest as dflow
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
@@ -49,6 +47,11 @@ class TfidfOutput:
     df: np.ndarray  # f[vocab]
     idf: np.ndarray  # f[vocab]
     metrics: MetricsRecorder
+    # Raw per-pair counts + per-doc lengths ride along so a second
+    # weighting over the SAME postings (BM25 — dataflow/bm25.py) needs no
+    # corpus re-pass.  None on outputs built before this field existed.
+    count: np.ndarray | None = None  # f[nnz]
+    doc_lengths: np.ndarray | None = None  # int32 [n_docs]
 
     @property
     def nnz(self) -> int:
@@ -109,28 +112,16 @@ def run_tfidf(
         df=np.asarray(result.df),
         idf=np.asarray(result.idf),
         metrics=metrics,
+        count=np.asarray(result.count[:n_pairs]),
+        doc_lengths=np.asarray(corpus.doc_lengths),
     )
 
 
-def grow_chunk_cap(
-    need: int, cap: int, metrics: MetricsRecorder, *, min_bits: int = 10,
-    **context
-) -> tuple[int, bool]:
-    """Fixed-shape capacity policy, shared by the streaming/sharded ingest
-    paths AND the serving micro-batcher: power-of-two start (at least
-    ``2**min_bits`` — the ingest default of 10 keeps token chunks
-    kernel-sized; the serving batcher passes 0 so a batch of 3 pads to 4,
-    not 1024), doubling bumps (each bump is a logged recompile —
-    SURVEY.md §7 'fixed shapes under jit').  Returns (cap, changed)."""
-    changed = False
-    if cap <= 0:
-        cap = 1 << max(min_bits, int(np.ceil(np.log2(max(need, 1)))))
-        changed = True
-    while need > cap:
-        cap *= 2
-        changed = True
-        metrics.record(event="chunk_cap_bump", cap=cap, **context)
-    return cap, changed
+# The fixed-shape capacity policy moved into the dataflow core
+# (dataflow/ingest.py) with the rest of the chunked-ingest machinery; the
+# re-export keeps this module the policy's public address for the serving
+# micro-batcher and the lint registry's shape matrices.
+grow_chunk_cap = dflow.grow_chunk_cap
 
 
 def stream_pad_plan(
@@ -315,6 +306,7 @@ def finalize_tfidf(
         n_docs=n_docs, vocab_bits=cfg.vocab_bits,
         doc=doc_a, term=term_a, weight=weight.astype(dtype),
         df=df_total, idf=idf, metrics=metrics,
+        count=count_a, doc_lengths=doc_lengths,
     )
 
 
@@ -329,9 +321,6 @@ def _pad_chunk(
     term_ids[:t] = corpus.term_ids
     valid[:t] = True
     return doc_ids, term_ids, valid
-
-
-_QUEUE_END = object()
 
 
 def _tokenized_chunks(
@@ -377,50 +366,10 @@ def _tokenized_chunks(
         yield i, corpus
 
 
-def _prefetched(source: Iterator, depth: int) -> Iterator:
-    """Run ``source`` on a background thread, buffering up to ``depth``
-    items (SURVEY.md §5.7 double-buffered ingest).  Tokenizing is host
-    C++/numpy that releases the GIL, so it genuinely overlaps the XLA chunk
-    kernel.  Exceptions are forwarded and re-raised on the consumer side;
-    if the consumer abandons the generator (exception or early close), the
-    producer notices via a stop event and exits instead of blocking forever
-    on a full queue."""
-    q: queue.Queue = queue.Queue(maxsize=depth)
-    stop = threading.Event()
-
-    def put(item) -> bool:
-        while not stop.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def producer() -> None:
-        try:
-            for item in source:
-                if not put(item):
-                    return
-        except BaseException as exc:  # noqa: BLE001 — forwarded to consumer
-            put(exc)
-        else:
-            put(_QUEUE_END)
-
-    thread = threading.Thread(target=producer, name="tfidf-tokenizer",
-                              daemon=True)
-    thread.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _QUEUE_END:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        stop.set()
-        thread.join()
+# The background-thread source buffer is dataflow machinery now
+# (dataflow/ingest.py); the sharded ingest path still imports it under
+# this name.
+_prefetched = dflow.prefetched
 
 
 def run_tfidf_streaming(
@@ -474,15 +423,25 @@ def run_tfidf_streaming(
 
     depth = max(int(cfg.prefetch), 0)
     source = _tokenized_chunks(doc_chunks, cfg, st.chunk_index, st.n_docs)
-    if depth > 0:
-        source = _prefetched(source, depth)
 
-    # In-flight launched chunks: (i, counts, doc_lengths, n_chunk_docs,
-    # n_tokens, launch Timer).
-    inflight: collections.deque = collections.deque()
+    def launch(item):
+        """Pad one tokenized chunk to the fixed capacity and dispatch the
+        once-compiled kernel (async); the in-flight record carries what
+        the drain needs to commit it."""
+        nonlocal cap, df_dev
+        i, corpus = item
+        cap, _ = grow_chunk_cap(corpus.n_tokens, cap, metrics, chunk=i)
+        doc_ids, term_ids, valid = _pad_chunk(corpus, cap)
+        with Timer() as t:
+            counts, df_dev = ops.chunk_counts_carry(
+                jnp.asarray(doc_ids), jnp.asarray(term_ids),
+                jnp.asarray(valid), df_dev, vocab=vocab,
+            )  # async dispatch — no block here; df carry updated in place
+        return (i, counts, corpus.doc_lengths,
+                corpus.n_docs, corpus.n_tokens, t)
 
-    def drain_one():
-        i, counts, doc_lengths, n_chunk_docs, n_tokens, t = inflight.popleft()
+    def drain_one(rec):
+        i, counts, doc_lengths, n_chunk_docs, n_tokens, t = rec
         with Timer() as t_sync, obs.span("tfidf.chunk", chunk=i):
             # Wait for this chunk's device results with ONE batched
             # device->host pull.  The old path paid five round-trips per
@@ -517,49 +476,43 @@ def run_tfidf_streaming(
         obs.histogram("tfidf.chunk_secs", t_sync.elapsed)
 
     def commit_df():
-        # Pull the device DF carry into host state.  Only legal when no
-        # launch is in flight: the carry always reflects every DISPATCHED
-        # chunk, so a mid-flight pull would commit DF for chunks the state
-        # does not count as ingested.  Its own site (not tfidf_chunk_sync):
-        # chaos schedules and retry tallies count per-chunk drains, and a
-        # commit is not a chunk.
-        assert not inflight, "DF commit with launches in flight"
+        # Pull the device DF carry into host state.  chunked_ingest calls
+        # this only when no launch is in flight: the carry always reflects
+        # every DISPATCHED chunk, so a mid-flight pull would commit DF for
+        # chunks the state does not count as ingested.  Its own site (not
+        # tfidf_chunk_sync): chaos schedules and retry tallies count
+        # per-chunk drains, and a commit is not a chunk.
         with obs.span("tfidf.df_commit"):
             st.df_total = rx.device_get(
                 df_dev, site="tfidf_df_commit", metrics=metrics,
                 checkpoint_dir=cfg.checkpoint_dir,
             ).astype(dtype)
 
-    def maybe_checkpoint():
-        nonlocal last_ckpt
+    def checkpoint_due() -> bool:
         if not (cfg.checkpoint_every > 0 and cfg.checkpoint_dir):
-            return
-        if st.chunk_index - last_ckpt < cfg.checkpoint_every:
-            return
-        while inflight:  # drain to the commit point (see commit_df)
-            drain_one()
-        commit_df()
+            return False
+        return st.chunk_index - last_ckpt >= cfg.checkpoint_every
+
+    def save_ckpt():
+        nonlocal last_ckpt
         st.ingest_secs = secs0 + (time.perf_counter() - run_started)
         save_ingest_checkpoint(cfg, metrics, st)
         last_ckpt = st.chunk_index
 
+    # The host pipeline — bounded in-flight launches, drain-to-commit
+    # checkpoints, background source prefetch — is the dataflow core's
+    # chunked_ingest primitive; this driver only supplies the TF-IDF
+    # closures (and keeps its guarded sites/spans byte-identical to the
+    # pre-port path).
     with obs.span("tfidf.stream", resume_chunk=st.chunk_index):
-        for i, corpus in source:
-            cap, _ = grow_chunk_cap(corpus.n_tokens, cap, metrics, chunk=i)
-            doc_ids, term_ids, valid = _pad_chunk(corpus, cap)
-            with Timer() as t:
-                counts, df_dev = ops.chunk_counts_carry(
-                    jnp.asarray(doc_ids), jnp.asarray(term_ids),
-                    jnp.asarray(valid), df_dev, vocab=vocab,
-                )  # async dispatch — no block here; df carry updated in place
-            inflight.append((i, counts, corpus.doc_lengths,
-                             corpus.n_docs, corpus.n_tokens, t))
-            while len(inflight) > depth:
-                drain_one()
-            maybe_checkpoint()
-        while inflight:
-            drain_one()
-            maybe_checkpoint()
-        commit_df()
+        dflow.chunked_ingest(
+            source,
+            launch=launch,
+            drain=drain_one,
+            commit=commit_df,
+            depth=depth,
+            checkpoint_due=checkpoint_due,
+            save_checkpoint=save_ckpt,
+        )
 
     return finalize_tfidf(st, cfg, metrics)

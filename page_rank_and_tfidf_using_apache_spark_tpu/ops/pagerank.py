@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.fixpoint import iterate
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
     DanglingMode,
@@ -475,7 +476,12 @@ def spmv_sort_shuffle(
     )
 
 
-def _spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
+def spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
+    """The one SpMV dispatch point: route a weighted gather+combine
+    through the impl the graph's static layout was built for.  This is
+    the ``dataflow.graph_combine`` shuffle backend — every fixpoint
+    workload (PageRank, personalized PageRank, HITS) shares these tuned
+    impls instead of owning scatter strategy privately."""
     if impl == "segment":
         return spmv_segment(dg, weighted, n)
     if impl == "bcoo":
@@ -522,7 +528,7 @@ def pagerank_step(
     preserved every step.
     """
     weighted = ranks * dg.inv_outdeg
-    contribs = _spmv(dg, weighted, n, impl)
+    contribs = spmv(dg, weighted, n, impl)
     if dangling is DanglingMode.REDISTRIBUTE:
         # lost mass re-enters through the restart distribution e; on a
         # sharded mesh this sum is the lax.psum of BASELINE.json:5.
@@ -544,10 +550,10 @@ def spark_exact_step(
     state: SparkExactState, dg: DeviceGraph, *, n: int, damping: float, impl: str = "segment"
 ) -> SparkExactState:
     weighted = state.ranks * state.present * dg.inv_outdeg
-    contribs = _spmv(dg, weighted, n, impl)
+    contribs = spmv(dg, weighted, n, impl)
     # A node re-enters the table iff some present source with out-links
     # points at it (join emits ≥1 record for it).
-    received = _spmv(dg, state.present * dg.has_outlinks, n, impl)
+    received = spmv(dg, state.present * dg.has_outlinks, n, impl)
     present = (received > 0).astype(state.ranks.dtype)
     ranks = present * ((1.0 - damping) + damping * contribs)
     return SparkExactState(ranks=ranks, present=present)
@@ -571,6 +577,10 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig):
     segment's output into the next, so it never reuses one; bench.py re-puts
     per timing rep).  The tier-3 donation verifier (analysis/cost.py) holds
     this contract against the lowered computation's input/output aliasing.
+
+    The loop skeleton is the dataflow core's :func:`dataflow.fixpoint
+    .iterate` combinator — one scan/while implementation shared with the
+    sharded runner and every new fixpoint workload.
     """
     damping = cfg.damping
     impl = cfg.spmv_impl
@@ -584,34 +594,12 @@ def make_pagerank_runner(n: int, cfg: PageRankConfig):
             total_mass=total_mass, impl=impl,
         )
 
-    if cfg.tol > 0.0:
-
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def run(dg: DeviceGraph, ranks0: jax.Array, e: jax.Array):
-            def cond(carry):
-                _, delta, it = carry
-                return jnp.logical_and(delta > cfg.tol, it < cfg.iterations)
-
-            def body(carry):
-                ranks, _, it = carry
-                new = step_fn(ranks, dg, e)
-                return new, jnp.sum(jnp.abs(new - ranks)), it + 1
-
-            init = (ranks0, jnp.array(jnp.inf, ranks0.dtype), jnp.array(0, jnp.int32))
-            ranks, delta, it = jax.lax.while_loop(cond, body, init)
-            return ranks, it, delta
-
-        return run
-
     @functools.partial(jax.jit, donate_argnums=(1,))
     def run(dg: DeviceGraph, ranks0: jax.Array, e: jax.Array):
-        def body(ranks, _):
-            new = step_fn(ranks, dg, e)
-            return new, jnp.sum(jnp.abs(new - ranks))
-
-        ranks, deltas = jax.lax.scan(body, ranks0, None, length=cfg.iterations)
-        last = deltas[-1] if cfg.iterations > 0 else jnp.array(jnp.inf, ranks0.dtype)
-        return ranks, jnp.array(cfg.iterations, jnp.int32), last
+        return iterate(
+            lambda ranks: step_fn(ranks, dg, e), ranks0,
+            iterations=cfg.iterations, tol=cfg.tol,
+        )
 
     return run
 
@@ -625,14 +613,14 @@ def make_spark_exact_runner(n: int, cfg: PageRankConfig):
     def run(dg: DeviceGraph, ranks0: jax.Array, e: jax.Array):
         del e  # spark_exact is never personalized
         state0 = SparkExactState(ranks=ranks0, present=dg.has_outlinks)
-
-        def body(state, _):
-            new = spark_exact_step(state, dg, n=n, damping=cfg.damping, impl=cfg.spmv_impl)
-            delta = jnp.sum(jnp.abs(new.ranks - state.ranks))
-            return new, delta
-
-        state, deltas = jax.lax.scan(body, state0, None, length=cfg.iterations)
-        last = deltas[-1] if cfg.iterations > 0 else jnp.array(jnp.inf, ranks0.dtype)
-        return state.ranks, jnp.array(cfg.iterations, jnp.int32), last
+        state, iters, last = iterate(
+            lambda s: spark_exact_step(
+                s, dg, n=n, damping=cfg.damping, impl=cfg.spmv_impl
+            ),
+            state0,
+            iterations=cfg.iterations,
+            delta_fn=lambda new, old: jnp.sum(jnp.abs(new.ranks - old.ranks)),
+        )
+        return state.ranks, iters, last
 
     return run

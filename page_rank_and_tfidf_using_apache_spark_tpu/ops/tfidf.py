@@ -46,7 +46,13 @@ class SparseCounts(NamedTuple):
 
 class TfidfResult(NamedTuple):
     """Sparse per-(doc, term) TF-IDF weights + the dense IDF vector (the
-    reference's joined A10 output plus the broadcast IDF table R3)."""
+    reference's joined A10 output plus the broadcast IDF table R3).
+
+    ``count`` carries the raw per-pair term counts alongside the
+    finalized weights: the BM25 ranker (dataflow/bm25.py) re-weights the
+    SAME postings from counts, so the pipeline exports them instead of
+    forcing a second corpus pass.  Optional (None) for legacy callers
+    that build a result by hand."""
 
     doc: jax.Array  # int32 [cap]
     term: jax.Array  # int32 [cap]
@@ -55,6 +61,7 @@ class TfidfResult(NamedTuple):
     valid: jax.Array  # f[cap]
     idf: jax.Array  # f[vocab]
     df: jax.Array  # f[vocab]
+    count: jax.Array | None = None  # f[cap] raw per-pair counts
 
 
 def count_pairs(
@@ -178,6 +185,7 @@ def tfidf_pipeline(
     return TfidfResult(
         doc=counts.doc, term=counts.term, weight=w,
         n_pairs=counts.n_pairs, valid=counts.valid, df=df, idf=idf,
+        count=counts.count,
     )
 
 

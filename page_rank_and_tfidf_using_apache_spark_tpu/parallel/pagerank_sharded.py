@@ -79,6 +79,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.compat import shard_map
 
 from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import fixpoint as dataflow
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.partition import (
+    PartitionedArray,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
 from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
 from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import PageRankResult
@@ -132,7 +136,20 @@ def auto_select_strategy(
     # (src/dst int32 + valid).
     node_state = 6 * graph.n_nodes * item
     edge_state = (graph.n_edges / max(n_devices, 1)) * (8 + item)
+    # Every exit publishes ONE strategy_decision event carrying the
+    # measured inputs, so trace_report can show WHY a run picked its
+    # strategy (ISSUE 9 satellite) — today the choice was invisible in
+    # traces.  No-op outside a traced run.
+    inputs = dict(
+        devices=n_devices,
+        nodes=graph.n_nodes, edges=graph.n_edges,
+        node_state_bytes=int(node_state), edge_state_bytes=int(edge_state),
+        hbm_bytes=int(hbm_bytes),
+    )
     if node_state + edge_state > hbm_bytes / 2:
+        obs.emit("strategy_decision", chosen="nodes_balanced",
+                 reason="replicated node state exceeds half the per-chip "
+                        "HBM budget", **inputs)
         return "nodes_balanced"
     # Replicated state fits — prefer the degree-aware hybrid layout when
     # the graph has a dense-worthy power-law head covering a meaningful
@@ -145,8 +162,17 @@ def auto_select_strategy(
         indeg, graph.n_edges, coverage=head_coverage,
         row_width=head_row_width,
     )
-    if head_ids.size and int(indeg[head_ids].sum()) >= graph.n_edges // 4:
+    head_edges = int(indeg[head_ids].sum()) if head_ids.size else 0
+    inputs.update(head_nodes=int(head_ids.size), head_edges=head_edges,
+                  head_edge_frac=round(head_edges / max(graph.n_edges, 1), 4))
+    if head_ids.size and head_edges >= graph.n_edges // 4:
+        obs.emit("strategy_decision", chosen="hybrid",
+                 reason="replicated state fits and the power-law head "
+                        "covers >=25% of edges", **inputs)
         return "hybrid"
+    obs.emit("strategy_decision", chosen="edges",
+             reason="replicated state fits; no dense-worthy degree head",
+             **inputs)
     return "edges"
 
 
@@ -174,6 +200,21 @@ class PartitionPlan(NamedTuple):
     # 'hybrid' only: (head node count, dense row width, total dense rows,
     # dense rows per device) — the head side of the slot accounting
     head: tuple[int, int, int, int] | None = None
+
+
+
+def _publish_plan(plan: PartitionPlan, n_devices: int) -> PartitionPlan:
+    """Log the chosen partition plan (strategy + the numbers that drove
+    it) as ONE obs event, so a trace explains the layout a run executed
+    with (ISSUE 9 satellite: trace_report's strategy section).  No-op
+    outside a traced run — the tier-3 lint calls plan_partition freely."""
+    obs.emit(
+        "partition_plan", strategy=plan.strategy, devices=n_devices,
+        n=plan.n, n_pad=plan.n_pad, block=plan.block, e_dev=plan.e_dev,
+        pad_frac=round(float(plan.pad_frac), 6),
+        head=(list(plan.head) if plan.head is not None else None),
+    )
+    return plan
 
 
 def plan_partition(
@@ -212,8 +253,11 @@ def plan_partition(
         e_dev = max(1, math.ceil(e_tail / d))
         slots = d * (e_dev + rows_dev * w)
         pad_frac = (slots - e) / max(slots, 1)
-        return PartitionPlan(strategy, n, block * d, block, e_dev, pad_frac,
-                             head=(int(head_ids.size), int(w), rows, rows_dev))
+        return _publish_plan(
+            PartitionPlan(strategy, n, block * d, block, e_dev, pad_frac,
+                          head=(int(head_ids.size), int(w), rows, rows_dev)),
+            d,
+        )
 
     if strategy in ("src", "src_ring"):
         block = max(1, math.ceil(n / d))
@@ -221,15 +265,20 @@ def plan_partition(
         per = np.bincount(graph.src // block, minlength=d)
         e_dev = max(1, int(per.max()))
         pad_frac = (d * e_dev - e) / max(d * e_dev, 1)
-        return PartitionPlan(strategy, n, n_pad, block, e_dev, pad_frac,
-                             per=per)
+        return _publish_plan(
+            PartitionPlan(strategy, n, n_pad, block, e_dev, pad_frac,
+                          per=per),
+            d,
+        )
 
     if strategy == "edges":
         block = max(1, math.ceil(n / d))
         e_dev = max(1, math.ceil(e / d))
         cap = e_dev * d
         pad_frac = (cap - e) / max(cap, 1)
-        return PartitionPlan(strategy, n, block * d, block, e_dev, pad_frac)
+        return _publish_plan(
+            PartitionPlan(strategy, n, block * d, block, e_dev, pad_frac), d
+        )
 
     if strategy == "nodes":
         block = max(1, math.ceil(n / d))
@@ -278,8 +327,11 @@ def plan_partition(
     ebounds = np.searchsorted(graph.dst, bounds_nodes)
     e_dev = max(1, int(np.diff(ebounds).max()))
     pad_frac = (d * e_dev - e) / max(d * e_dev, 1)
-    return PartitionPlan(strategy, n, block * d, block, e_dev, pad_frac,
-                         bounds_nodes=bounds_nodes, ebounds=ebounds)
+    return _publish_plan(
+        PartitionPlan(strategy, n, block * d, block, e_dev, pad_frac,
+                      bounds_nodes=bounds_nodes, ebounds=ebounds),
+        d,
+    )
 
 
 class ShardedGraph(NamedTuple):
@@ -650,27 +702,13 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
         local_delta = lambda new, old: coll.psum(jnp.sum(jnp.abs(new - old)), axis)
 
     def loop(ranks0, *arrays):
-        if cfg.tol > 0.0:
-            def cond(carry):
-                _, delta, it = carry
-                return jnp.logical_and(delta > cfg.tol, it < cfg.iterations)
-
-            def body(carry):
-                ranks, _, it = carry
-                new = step(ranks, *arrays)
-                return new, local_delta(new, ranks), it + 1
-
-            init = (ranks0, jnp.array(jnp.inf, ranks0.dtype), jnp.array(0, jnp.int32))
-            ranks, delta, it = lax.while_loop(cond, body, init)
-            return ranks, it, delta
-
-        def body(ranks, _):
-            new = step(ranks, *arrays)
-            return new, local_delta(new, ranks)
-
-        ranks, deltas = lax.scan(body, ranks0, None, length=cfg.iterations)
-        last = deltas[-1] if cfg.iterations > 0 else jnp.array(jnp.inf, ranks0.dtype)
-        return ranks, jnp.array(cfg.iterations, jnp.int32), last
+        # one scan/while skeleton for every fixpoint in the repo: the
+        # dataflow core's iterate combinator (dataflow/fixpoint.py), with
+        # this strategy's collective delta as the convergence gauge
+        return dataflow.iterate(
+            lambda ranks: step(ranks, *arrays), ranks0,
+            iterations=cfg.iterations, tol=cfg.tol, delta_fn=local_delta,
+        )
 
     edge_spec = P(axis, None)
     mapped = shard_map(
@@ -741,6 +779,11 @@ class _ShardedExec:
         )
         self.e_vec = jax.device_put(_restart_padded(self.sg, cfg),
                                     self.state_sharding)
+        # the dataflow partitioned-collection view of the rank state: one
+        # logical [n] array behind the padded/relabeled device layout
+        self.layout = PartitionedArray.from_plan(
+            self.sg.n, self.sg.n_pad, self.sg.node_map, self.state_sharding
+        )
         self._cfg = cfg
         self._metrics = metrics
 
@@ -754,17 +797,15 @@ class _ShardedExec:
 
     def put_ranks(self, ranks_g: np.ndarray):
         """Global [n] ranks -> padded, sharded device state."""
-        return jax.device_put(
-            _to_padded(self.sg, ranks_g, self._cfg.dtype), self.state_sharding
-        )
+        return self.layout.put(ranks_g, self._cfg.dtype).value
 
     def extract_np(self, rd) -> np.ndarray:
         """Padded device state -> global [n] ranks (checkpoint payload)."""
         with obs.span("pagerank.ckpt_pull"):
-            return rx.device_get(
-                rd, site="pagerank_ckpt_pull", metrics=self._metrics,
+            return self.layout.with_value(rd).pull(
+                site="pagerank_ckpt_pull", metrics=self._metrics,
                 checkpoint_dir=self._cfg.checkpoint_dir,
-            )[self.sg.node_map]
+            )
 
 
 def _make_elastic_rebuild(graph: Graph, cfg: PageRankConfig, strategy: str,

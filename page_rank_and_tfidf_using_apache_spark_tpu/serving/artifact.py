@@ -36,6 +36,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import TfidfOutput
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    Bm25Config,
     TfidfConfig,
     config_to_json,
 )
@@ -60,6 +61,9 @@ class ServableIndex:
     df: np.ndarray  # f[vocab]
     ranks: np.ndarray | None  # f[n_docs] PageRank prior, or None
     extra: dict
+    # BM25 weights over the SAME postings rows (dataflow/bm25.py) — the
+    # A/B-able second ranker; None on indexes built without it.
+    bm25_weight: np.ndarray | None = None
 
     @property
     def nnz(self) -> int:
@@ -76,14 +80,19 @@ def save_index(
     cfg: TfidfConfig,
     *,
     ranks: np.ndarray | None = None,
+    bm25: Bm25Config | None = None,
     extra: dict | None = None,
 ) -> str:
-    """Serialize a TF-IDF build (+ optional PageRank doc prior) as the next
-    index version under ``directory``; returns the version path.
+    """Serialize a TF-IDF build (+ optional PageRank doc prior and BM25
+    second-ranker weights) as the next index version under ``directory``;
+    returns the version path.
 
     ``ranks`` must be per-*document* priors aligned with the output's doc
     ids (how documents map onto graph nodes is the caller's contract —
     the PageRank-over-citation-graph correspondence of the reference).
+    ``bm25`` re-weights the SAME postings COO from the output's raw
+    counts (dataflow/bm25.py) into one extra array, making the artifact
+    servable under either ranker per request.
     """
     if ranks is not None and ranks.shape[0] != output.n_docs:
         raise ValueError(
@@ -99,6 +108,14 @@ def save_index(
     }
     if ranks is not None:
         arrays["ranks"] = np.ascontiguousarray(ranks)
+    if bm25 is not None:
+        from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.bm25 import (
+            bm25_from_tfidf,
+        )
+
+        arrays["bm25_weight"] = np.ascontiguousarray(
+            bm25_from_tfidf(output, bm25)
+        )
     version = ckpt.next_version(directory)
     meta = {
         "format": INDEX_FORMAT,
@@ -106,6 +123,9 @@ def save_index(
         "vocab_bits": int(output.vocab_bits),
         "nnz": int(output.nnz),
         "has_ranks": ranks is not None,
+        "has_bm25": bm25 is not None,
+        "bm25_config": (json.loads(config_to_json(bm25))
+                        if bm25 is not None else None),
         "tfidf_config": json.loads(config_to_json(cfg)),
         **(extra or {}),
     }
@@ -163,4 +183,5 @@ def load_index(
         df=arrays["df"],
         ranks=arrays.get("ranks"),
         extra=extra,
+        bm25_weight=arrays.get("bm25_weight"),
     )

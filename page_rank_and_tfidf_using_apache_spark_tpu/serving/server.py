@@ -127,16 +127,21 @@ def serve_pad_plan(
     return [("serve", pad_frac)]
 
 
+RANKERS = ("tfidf", "bm25")
+
+
 class _Pending:
     """One in-flight request: a tiny future the drain thread resolves."""
 
-    __slots__ = ("key", "q_term", "q_weight", "t_submit", "t_done",
+    __slots__ = ("key", "q_term", "q_weight", "ranker", "t_submit", "t_done",
                  "t_queue_wait", "cache", "_event", "_result", "_error")
 
-    def __init__(self, key: bytes, q_term: np.ndarray, q_weight: np.ndarray):
+    def __init__(self, key: bytes, q_term: np.ndarray, q_weight: np.ndarray,
+                 ranker: str = "tfidf"):
         self.key = key
         self.q_term = q_term
         self.q_weight = q_weight
+        self.ranker = ranker
         self.t_submit = time.perf_counter()
         self.t_done: float | None = None
         self.t_queue_wait = 0.0
@@ -213,6 +218,8 @@ class TfidfServer:
         self._queue: queue.Queue = queue.Queue(maxsize=cfg.queue_depth)
         self._thread: threading.Thread | None = None
         self._started = False
+        self._valid = None
+        self._weights: dict = {}
         self._cache: collections.OrderedDict[bytes, tuple] = collections.OrderedDict()
         self._lock = threading.Lock()  # cache + stats
         # Orders submit()'s {started-check, enqueue} against stop()'s flag
@@ -237,13 +244,24 @@ class TfidfServer:
         idx = self.index
         with obs.span("serve.load", version=idx.version, nnz=idx.nnz):
             # the artifact arrays are mmap views; device_put pages them in
-            # exactly once, then queries touch only device memory
+            # exactly once, then queries touch only device memory.  The
+            # per-ranker weight tables live side by side over the SAME
+            # doc/term postings; ranker selection swaps a traced operand,
+            # never a program.
             self._dev = (
                 jnp.asarray(np.ascontiguousarray(idx.doc)),
                 jnp.asarray(np.ascontiguousarray(idx.term)),
-                jnp.asarray(np.ascontiguousarray(idx.weight)),
-                jnp.ones(idx.nnz, idx.weight.dtype),
             )
+            self._valid = jnp.ones(idx.nnz, idx.weight.dtype)
+            self._weights = {
+                "tfidf": jnp.asarray(np.ascontiguousarray(idx.weight)),
+            }
+            if idx.bm25_weight is not None:
+                self._weights["bm25"] = jnp.asarray(
+                    np.ascontiguousarray(
+                        idx.bm25_weight.astype(idx.weight.dtype)
+                    )
+                )
             prior_np = (
                 (self.cfg.rank_alpha * np.ascontiguousarray(idx.ranks))
                 if self.cfg.rank_alpha > 0
@@ -271,14 +289,20 @@ class TfidfServer:
     def warmup(self) -> list[int]:
         """Compile (and fence) every padded batch shape the policy can
         produce.  After this, a request can only ever hit a warm
-        executable — the 'compiled runners warm' half of the tentpole."""
+        executable — the 'compiled runners warm' half of the tentpole.
+        One pass covers BOTH rankers: the weight table is a traced
+        operand of the same shape/dtype, so tfidf and bm25 share every
+        compiled executable."""
         caps = batch_shape_matrix(self.cfg.max_batch)
         q = self.cfg.max_query_terms
         for cap in caps:
             with obs.span("serve.warmup", batch=cap):
                 zt = np.zeros((cap, q), np.int32)
                 zw = np.zeros((cap, q), self.index.weight.dtype)
-                out = self._runner(*self._dev, zt, zw, zw, self._prior)
+                out = self._runner(
+                    *self._dev, self._weights["tfidf"], self._valid,
+                    zt, zw, zw, self._prior,
+                )
                 rx.block_until_ready(
                     out, site="serve_warmup", metrics=self.metrics
                 )
@@ -340,18 +364,33 @@ class TfidfServer:
         return uniq.astype(np.int32), counts.astype(self.index.weight.dtype)
 
     @staticmethod
-    def query_key(q_term: np.ndarray, q_weight: np.ndarray) -> bytes:
-        """LRU key: hash of the canonical sparse query vector."""
+    def query_key(q_term: np.ndarray, q_weight: np.ndarray,
+                  ranker: str = "tfidf") -> bytes:
+        """LRU key: hash of the canonical sparse query vector + the
+        ranker that scored it (an A/B pair must never share a cache
+        entry)."""
         h = hashlib.sha1()
+        h.update(ranker.encode())
         h.update(q_term.tobytes())
         h.update(q_weight.tobytes())
         return h.digest()
 
-    def submit(self, terms: Sequence[str]) -> _Pending:
+    def submit(self, terms: Sequence[str], *, ranker: str = "tfidf") -> _Pending:
         """Enqueue one query; returns a future.  Blocks when the bounded
-        queue is full (backpressure, not unbounded memory)."""
+        queue is full (backpressure, not unbounded memory).  ``ranker``
+        picks the weight table per request (the A/B switch): ``tfidf``
+        always, ``bm25`` when the index artifact bundles BM25 weights."""
+        if ranker not in RANKERS:
+            raise ValueError(f"unknown ranker {ranker!r} (want {RANKERS})")
+        if ranker == "bm25" and self.index.bm25_weight is None:
+            raise ValueError(
+                "this index carries no BM25 weights — rebuild with "
+                "save_index(..., bm25=Bm25Config()) / cli.tfidf "
+                "--save-index (BM25 is bundled by default)"
+            )
         q_term, q_weight = self.make_query(terms)
-        pending = _Pending(self.query_key(q_term, q_weight), q_term, q_weight)
+        pending = _Pending(self.query_key(q_term, q_weight, ranker),
+                           q_term, q_weight, ranker)
         with self._submit_lock:
             # the started-check AND the enqueue happen under the lock
             # stop() flips the flag under, so a racing submit either
@@ -363,13 +402,18 @@ class TfidfServer:
             self._queue.put(pending)
         with self._lock:
             self._stats["requests"] += 1
+            # per-ranker traffic split for the A/B read-out — counted at
+            # submit so cache hits are included, unlike the per-dispatch
+            # tallies in _serve_group
+            self._stats[f"requests_{ranker}"] += 1
         return pending
 
     def query(
-        self, terms: Sequence[str], timeout: float | None = 30.0
+        self, terms: Sequence[str], timeout: float | None = 30.0,
+        *, ranker: str = "tfidf",
     ) -> tuple[np.ndarray, np.ndarray]:
         """Synchronous convenience wrapper: submit + wait."""
-        return self.submit(terms).result(timeout)
+        return self.submit(terms, ranker=ranker).result(timeout)
 
     def stats(self) -> dict:
         with self._lock:
@@ -472,64 +516,81 @@ class TfidfServer:
                     misses.append(p)
             if not misses:
                 return
-            # In-batch dedup: N copies of one hot query arriving inside a
-            # single flush window dispatch ONCE (the cache can only serve
-            # repeats across batches; this closes the within-batch gap).
-            groups: dict[bytes, list[_Pending]] = {}
+            # Per-ranker groups: an A/B batch dispatches once per ranker
+            # present (the weight table is a per-dispatch operand; shapes
+            # — and therefore executables — are shared, so a mixed batch
+            # still never compiles).  The overwhelmingly common case is
+            # one ranker per flush window = one dispatch, exactly the
+            # pre-A/B behavior.
+            by_ranker: dict[str, list[_Pending]] = {}
             for p in misses:
-                groups.setdefault(p.key, []).append(p)
-            uniq = [ps[0] for ps in groups.values()]
-            for ps in groups.values():
-                for p in ps[1:]:
-                    p.cache = "dedup"
-            with self._lock:
-                self._stats["cache_misses"] += len(uniq)
-                self._stats["dedup_hits"] += len(misses) - len(uniq)
-                self._stats["batches"] += 1
-            obs.counter("serve.cache_misses", len(uniq))
+                by_ranker.setdefault(p.ranker, []).append(p)
+            for ranker, plist in by_ranker.items():
+                self._serve_group(ranker, plist, batch_size=len(batch))
 
-            q = self.cfg.max_query_terms
-            cap = batch_cap(len(uniq), self.cfg.max_batch, self.metrics)
-            with obs.span("serve.pad", size=len(uniq), cap=cap):
-                dtype = self.index.weight.dtype
-                q_term = np.zeros((cap, q), np.int32)
-                q_weight = np.zeros((cap, q), dtype)
-                q_valid = np.zeros((cap, q), dtype)
-                for i, p in enumerate(uniq):
-                    m = min(p.q_term.shape[0], q)
-                    q_term[i, :m] = p.q_term[:m]
-                    q_weight[i, :m] = p.q_weight[:m]
-                    q_valid[i, :m] = 1.0
-            try:
-                with obs.span("serve.dispatch", cap=cap):
-                    scores_dev, idx_dev = rx.run_guarded(
-                        lambda: self._runner(
-                            *self._dev, q_term, q_weight, q_valid, self._prior
-                        ),
-                        site="serve_dispatch", metrics=self.metrics,
-                    )
-                with obs.span("serve.pull", cap=cap):
-                    # ONE batched [cap, k] pull — the only bytes that ever
-                    # cross device->host per batch
-                    scores, idx = rx.device_get(
-                        (scores_dev, idx_dev), site="serve_pull",
-                        metrics=self.metrics,
-                    )
-            except Exception as exc:  # noqa: BLE001 — isolated per batch
-                # fail exactly this batch's requests; the drain loop (and
-                # every other queued request) keeps going — per-request
-                # degradation, not a server crash
-                with self._lock:
-                    self._stats["batch_errors"] += 1
-                obs.counter("serve.batch_errors")
-                err = f"{type(exc).__name__}: {exc}"[:200]
-                for p in misses:
-                    p._fail(exc)
-                    self._publish_request(p, batch=len(batch), error=err)
-                return
-            for i, key in enumerate(groups):
-                result = (scores[i].copy(), idx[i].copy())
-                self._cache_put(key, result)
-                for p in groups[key]:
-                    p._resolve(result)
-                    self._publish_request(p, batch=len(batch))
+    def _serve_group(self, ranker: str, misses: list[_Pending],
+                     *, batch_size: int) -> None:
+        """Dedup, pad, dispatch and resolve one ranker's share of a
+        micro-batch."""
+        # In-batch dedup: N copies of one hot query arriving inside a
+        # single flush window dispatch ONCE (the cache can only serve
+        # repeats across batches; this closes the within-batch gap).
+        groups: dict[bytes, list[_Pending]] = {}
+        for p in misses:
+            groups.setdefault(p.key, []).append(p)
+        uniq = [ps[0] for ps in groups.values()]
+        for ps in groups.values():
+            for p in ps[1:]:
+                p.cache = "dedup"
+        with self._lock:
+            self._stats["cache_misses"] += len(uniq)
+            self._stats["dedup_hits"] += len(misses) - len(uniq)
+            self._stats["batches"] += 1
+        obs.counter("serve.cache_misses", len(uniq))
+
+        q = self.cfg.max_query_terms
+        cap = batch_cap(len(uniq), self.cfg.max_batch, self.metrics)
+        with obs.span("serve.pad", size=len(uniq), cap=cap, ranker=ranker):
+            dtype = self.index.weight.dtype
+            q_term = np.zeros((cap, q), np.int32)
+            q_weight = np.zeros((cap, q), dtype)
+            q_valid = np.zeros((cap, q), dtype)
+            for i, p in enumerate(uniq):
+                m = min(p.q_term.shape[0], q)
+                q_term[i, :m] = p.q_term[:m]
+                q_weight[i, :m] = p.q_weight[:m]
+                q_valid[i, :m] = 1.0
+        try:
+            with obs.span("serve.dispatch", cap=cap, ranker=ranker):
+                scores_dev, idx_dev = rx.run_guarded(
+                    lambda: self._runner(
+                        *self._dev, self._weights[ranker], self._valid,
+                        q_term, q_weight, q_valid, self._prior,
+                    ),
+                    site="serve_dispatch", metrics=self.metrics,
+                )
+            with obs.span("serve.pull", cap=cap):
+                # ONE batched [cap, k] pull — the only bytes that ever
+                # cross device->host per batch
+                scores, idx = rx.device_get(
+                    (scores_dev, idx_dev), site="serve_pull",
+                    metrics=self.metrics,
+                )
+        except Exception as exc:  # noqa: BLE001 — isolated per batch
+            # fail exactly this group's requests; the drain loop (and
+            # every other queued request) keeps going — per-request
+            # degradation, not a server crash
+            with self._lock:
+                self._stats["batch_errors"] += 1
+            obs.counter("serve.batch_errors")
+            err = f"{type(exc).__name__}: {exc}"[:200]
+            for p in misses:
+                p._fail(exc)
+                self._publish_request(p, batch=batch_size, error=err)
+            return
+        for i, key in enumerate(groups):
+            result = (scores[i].copy(), idx[i].copy())
+            self._cache_put(key, result)
+            for p in groups[key]:
+                p._resolve(result)
+                self._publish_request(p, batch=batch_size)

@@ -261,6 +261,80 @@ class TfidfConfig:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class HitsConfig:
+    """Configuration for a HITS (hubs/authorities) run — a second SpMV
+    fixpoint workload over the same graph substrate (dataflow/hits.py).
+    Field names mirror PageRankConfig so the shared segment driver
+    (dataflow.fixpoint.run_segments) drives it unchanged; iteration
+    semantics mirror networkx.hits (per-step max-normalization, L1
+    convergence on the hub vector, final sum-normalization)."""
+
+    iterations: int = 100
+    tol: float = 1e-8
+    dtype: str = "float32"
+    checkpoint_every: int = 0
+    checkpoint_dir: str | None = None
+
+    def __post_init__(self) -> None:
+        if self.iterations < 0:
+            raise ValueError(f"iterations must be >= 0, got {self.iterations}")
+        if self.tol < 0:
+            raise ValueError(f"tol must be >= 0, got {self.tol}")
+
+    def config_hash(self) -> str:
+        return _hash_config(
+            self, exclude={"iterations", "tol", "checkpoint_every", "checkpoint_dir"}
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ComponentsConfig:
+    """Configuration for connected components via min-label propagation
+    (dataflow/components.py): the PageRank SpMV skeleton with a ``min``
+    combine, iterated until no label changes.  ``iterations`` caps the
+    label-propagation rounds (>= the undirected diameter for an exact
+    answer; the run stops early the step nothing changes)."""
+
+    iterations: int = 200
+    checkpoint_every: int = 0
+    checkpoint_dir: str | None = None
+    # Fixed convergence gauge: delta is the COUNT of labels that changed,
+    # so any tol in (0, 1) means "stop when nothing changed".  Declared a
+    # field (not a property) so dataclasses.replace in the segment driver
+    # round-trips it.
+    tol: float = 0.5
+
+    def __post_init__(self) -> None:
+        if self.iterations < 0:
+            raise ValueError(f"iterations must be >= 0, got {self.iterations}")
+
+    def config_hash(self) -> str:
+        return _hash_config(
+            self, exclude={"iterations", "tol", "checkpoint_every", "checkpoint_dir"}
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Bm25Config:
+    """Okapi BM25 weighting knobs (dataflow/bm25.py) — the second ranker
+    over the SAME postings COO the TF-IDF pipeline materializes.  The
+    Lucene idf variant ``log(1 + (N - df + 0.5)/(df + 0.5))`` keeps
+    weights non-negative."""
+
+    k1: float = 1.5
+    b: float = 0.75
+
+    def __post_init__(self) -> None:
+        if self.k1 < 0:
+            raise ValueError(f"k1 must be >= 0, got {self.k1}")
+        if not 0.0 <= self.b <= 1.0:
+            raise ValueError(f"b must be in [0, 1], got {self.b}")
+
+    def config_hash(self) -> str:
+        return _hash_config(self)
+
+
 def _to_jsonable(obj: Any) -> Any:
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         return {f.name: _to_jsonable(getattr(obj, f.name)) for f in dataclasses.fields(obj)}

@@ -83,3 +83,76 @@ def test_tfidf_cli_mesh_requires_streaming(tmp_path):
     (d / "a.txt").write_text("one doc")
     with pytest.raises(SystemExit):
         tfidf_cli.main([str(d), "--mesh", "4"])
+
+
+def test_workloads_cli_ppr_hits_cc(tmp_path, capsys):
+    from page_rank_and_tfidf_using_apache_spark_tpu.cli import (
+        workloads as wl_cli,
+    )
+
+    rc = wl_cli.main(["ppr", "synthetic:60,240,1", "--queries", "0,1", "2",
+                      "--iterations", "20", "--top-k", "2"])
+    assert rc == 0
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    assert len(lines) == 4  # 2 queries x top-2
+    assert {ln.split("\t")[0] for ln in lines} == {"0", "1"}
+
+    rc = wl_cli.main(["hits", "synthetic:60,240,1", "--top-k", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.count("hub\t") == 3 and out.count("auth\t") == 3
+
+    comp = tmp_path / "components.tsv"
+    rc = wl_cli.main(["cc", "synthetic:60,120,1", "--output", str(comp)])
+    assert rc == 0
+    rows = [ln.split("\t") for ln in comp.read_text().splitlines()]
+    assert rows and all(len(r) == 2 for r in rows)
+    # labels are canonical smallest-member ids: every component label is
+    # also a node mapped to itself
+    labels = {r[1] for r in rows}
+    selfmap = {r[0] for r in rows if r[0] == r[1]}
+    assert labels == selfmap
+
+
+def test_serve_cli_ranker_prefix(tmp_path, capsys, monkeypatch):
+    """End-to-end A/B through the CLIs: build an index with bundled BM25
+    weights via cli.tfidf --save-index, then serve one query under each
+    ranker via the @ prefix."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.cli import (
+        serve as serve_cli,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.cli import (
+        tfidf as tfidf_cli,
+    )
+
+    f = tmp_path / "corpus.txt"
+    f.write_text("apollo guidance computer\napollo program apollo\n"
+                 "guidance law\ncomputer science computer\n")
+    idx = tmp_path / "idx"
+    rc = tfidf_cli.main([str(f), "--lines", "--vocab-bits", "10",
+                         "--save-index", str(idx)])
+    assert rc == 0
+    q = tmp_path / "queries.txt"
+    q.write_text("@tfidf apollo\n@bm25 apollo\n")
+    rc = serve_cli.main([str(idx), "--queries", str(q), "--top-k", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out.splitlines()
+    q0 = sorted(ln for ln in out if ln.startswith("0\t"))
+    q1 = sorted(ln for ln in out if ln.startswith("1\t"))
+    assert q0 and q1
+    # same query, different ranker -> different scores
+    assert [ln.split("\t")[2] for ln in q0] != [ln.split("\t")[2] for ln in q1]
+
+    # a '@bm25' line against an index WITHOUT BM25 weights reports the
+    # error and keeps serving the rest of the stream (no crash)
+    idx2 = tmp_path / "idx2"
+    rc = tfidf_cli.main([str(f), "--lines", "--vocab-bits", "10",
+                         "--no-index-bm25", "--save-index", str(idx2)])
+    assert rc == 0
+    q2 = tmp_path / "queries2.txt"
+    q2.write_text("@bm25 apollo\napollo\n")
+    rc = serve_cli.main([str(idx2), "--queries", str(q2), "--top-k", "2"])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "no BM25 weights" in captured.err
+    assert any(ln.startswith("1\t") for ln in captured.out.splitlines())

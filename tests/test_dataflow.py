@@ -1,0 +1,337 @@
+"""dataflow/ core tests (ISSUE 9): primitive units + the port-equivalence
+pins that make the PageRank/TF-IDF move onto the dataflow primitives
+provably a refactor, not a rewrite.
+
+Pins:
+- PageRank ranks through the ported runners match an independent numpy
+  power iteration (the pre-port semantics) to 1e-6;
+- a PageRank program composed *directly* from the dataflow primitives
+  (broadcast_join → graph_combine → iterate) matches ``run_pagerank``;
+- streaming TF-IDF (now a thin program over ``chunked_ingest``) is
+  byte-equal to the batch pipeline;
+- the ``chunked_ingest`` pipeline preserves the drain-before-commit /
+  commit-before-checkpoint ordering the donated-carry design requires,
+  and chaos through the shared wiring stays invisible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu import dataflow
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.partition import (
+    PartitionedArray,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.io import synthetic_powerlaw
+from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import run_pagerank
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    run_tfidf,
+    run_tfidf_streaming,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    PageRankConfig,
+    TfidfConfig,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+
+
+# ------------------------------------------------------------- primitives
+
+
+def test_iterate_scan_matches_manual_loop():
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        return 0.5 * x + 1.0
+
+    x0 = jnp.arange(4.0)
+    out, iters, delta = jax.jit(
+        lambda x: dataflow.iterate(step, x, iterations=5)
+    )(x0)
+    want = np.arange(4.0)
+    for _ in range(5):
+        want = 0.5 * want + 1.0
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+    assert int(iters) == 5
+    prev = want * 2 - 2  # state before the last step: want = 0.5*prev + 1
+    np.testing.assert_allclose(float(delta), np.abs(want - prev).sum(),
+                               rtol=1e-5)
+
+
+def test_iterate_tol_stops_early_and_zero_iterations():
+    import jax
+
+    def step(x):
+        return x * 0.0  # one step reaches the fixpoint exactly
+
+    x0 = np.ones(8, np.float32)
+    out, iters, delta = jax.jit(
+        lambda x: dataflow.iterate(step, x, iterations=50, tol=1e-9)
+    )(x0)
+    assert int(iters) == 2  # step 1 zeroes, step 2 measures delta 0
+    assert float(delta) == 0.0
+    _, iters0, delta0 = jax.jit(
+        lambda x: dataflow.iterate(step, x, iterations=0)
+    )(x0)
+    assert int(iters0) == 0 and np.isinf(float(delta0))
+
+
+def test_segment_combine_ops():
+    import jax.numpy as jnp
+
+    vals = jnp.asarray(np.array([5.0, 1.0, 3.0, 2.0, 9.0], np.float32))
+    keys = jnp.asarray(np.array([0, 0, 1, 1, 1], np.int32))
+    add = dataflow.segment_combine(vals, keys, 3, op="add",
+                                   indices_are_sorted=True)
+    np.testing.assert_allclose(np.asarray(add), [6.0, 14.0, 0.0])
+    mn = dataflow.segment_combine(vals, keys, 3, op="min",
+                                  indices_are_sorted=True)
+    assert np.asarray(mn)[:2].tolist() == [1.0, 2.0]
+    mx = dataflow.segment_combine(vals, keys, 3, op="max")
+    assert np.asarray(mx)[:2].tolist() == [5.0, 9.0]
+    with pytest.raises(ValueError, match="unknown combine op"):
+        dataflow.segment_combine(vals, keys, 3, op="mean")
+
+
+def test_broadcast_join_is_the_gather():
+    import jax.numpy as jnp
+
+    table = jnp.asarray(np.array([10.0, 20.0, 30.0], np.float32))
+    keys = jnp.asarray(np.array([2, 0, 2], np.int32))
+    np.testing.assert_allclose(
+        np.asarray(dataflow.broadcast_join(table, keys)), [30.0, 10.0, 30.0]
+    )
+
+
+def test_partitioned_array_roundtrip_identity_and_relabeled():
+    n = 7
+    ident = PartitionedArray.identity(n)
+    x = np.arange(n, dtype=np.float32)
+    put = ident.put(x)
+    np.testing.assert_array_equal(put.pull(site="t"), x)
+
+    # relabeled + padded layout (a 'nodes_balanced'-style node_map)
+    node_map = np.array([3, 0, 5, 1, 8, 2, 7], np.int64)
+    pa = PartitionedArray.from_plan(n, 10, node_map)
+    put2 = pa.put(x)
+    padded = np.asarray(put2.value)
+    assert padded.shape == (10,)
+    np.testing.assert_array_equal(padded[node_map], x)
+    np.testing.assert_array_equal(put2.pull(site="t"), x)
+
+
+# ------------------------------------------------- port-equivalence pins
+
+
+GRAPH_KW = dict(dangling="redistribute", init="uniform", dtype="float32")
+
+
+def _numpy_pagerank(graph, iters: int, damping: float = 0.85) -> np.ndarray:
+    """The pre-port reference semantics as a plain numpy loop."""
+    n = graph.n_nodes
+    inv = np.where(graph.out_degree > 0,
+                   1.0 / np.maximum(graph.out_degree, 1), 0.0)
+    dang = (graph.out_degree == 0).astype(np.float64)
+    e = np.full(n, 1.0 / n)
+    r = np.full(n, 1.0 / n)
+    for _ in range(iters):
+        w = r * inv
+        contribs = np.zeros(n)
+        np.add.at(contribs, graph.dst, w[graph.src])
+        contribs += float(r @ dang) * e
+        r = (1 - damping) * e + damping * contribs
+    return r
+
+
+def test_ported_pagerank_matches_pre_port_reference():
+    """ISSUE 9 acceptance pin: the runners are now thin programs over
+    dataflow.iterate — ranks must still match the uninterrupted reference
+    to 1e-6 (f32) for both the scan and the while-loop fixpoints."""
+    g = synthetic_powerlaw(1500, 6000, seed=21)
+    want = _numpy_pagerank(g, 15)
+    res = run_pagerank(g, PageRankConfig(iterations=15, **GRAPH_KW))
+    np.testing.assert_allclose(res.ranks, want, atol=1e-6)
+    res_tol = run_pagerank(
+        g, PageRankConfig(iterations=500, tol=1e-10, **GRAPH_KW)
+    )
+    assert 0 < res_tol.iterations <= 500
+    np.testing.assert_allclose(
+        res_tol.ranks, _numpy_pagerank(g, res_tol.iterations), atol=1e-5
+    )
+
+
+def test_pagerank_composed_from_primitives_matches_runner():
+    """The marginal-cost claim in one test: PageRank expressed DIRECTLY
+    as broadcast_join → graph_combine → iterate (no ops.make_* runner)
+    equals the production path."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    g = synthetic_powerlaw(600, 2400, seed=5)
+    n = g.n_nodes
+    cfg = PageRankConfig(iterations=12, **GRAPH_KW)
+    dg = ops.put_graph(g, cfg.dtype)
+    e = jnp.asarray(ops.restart_vector(n, cfg))
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def program(ranks0):
+        def step(r):
+            weighted = r * dg.inv_outdeg  # mapValues
+            contribs = dataflow.graph_combine(dg, weighted, n)  # the shuffle
+            dmass = jnp.sum(r * dg.dangling)
+            contribs = contribs + dmass * e
+            return (1.0 - cfg.damping) * e + cfg.damping * contribs
+
+        return dataflow.iterate(step, ranks0, iterations=cfg.iterations)
+
+    ranks, iters, _ = program(jnp.asarray(ops.init_ranks(n, cfg)))
+    base = run_pagerank(g, cfg)
+    assert int(iters) == cfg.iterations
+    np.testing.assert_allclose(np.asarray(ranks), base.ranks, atol=1e-6)
+
+
+def test_streaming_over_chunked_ingest_byte_equal_to_batch(monkeypatch):
+    """ISSUE 9 acceptance pin: the streaming path (now a thin program
+    over dataflow.chunked_ingest) produces byte-identical weights to the
+    batch pipeline, at every prefetch depth."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.models import tfidf as mt
+
+    monkeypatch.setattr(mt, "DEVICE_FINALIZE_MIN_NNZ", 0)
+    docs = [f"alpha beta{i % 7} gamma{i % 3} shared token{i}"
+            for i in range(40)]
+    cfg = TfidfConfig(vocab_bits=10)
+    batch = run_tfidf(docs, cfg)
+
+    def key(out):
+        order = np.lexsort((out.doc, out.term))
+        return (out.doc[order], out.term[order], out.weight[order])
+
+    bd, bt, bw = key(batch)
+    chunks = [docs[i:i + 8] for i in range(0, len(docs), 8)]
+    for prefetch in (0, 2):
+        scfg = TfidfConfig(vocab_bits=10, chunk_tokens=64, prefetch=prefetch)
+        stream = run_tfidf_streaming(iter(chunks), scfg)
+        sd, st, sw = key(stream)
+        np.testing.assert_array_equal(sd, bd)
+        np.testing.assert_array_equal(st, bt)
+        assert sw.tobytes() == bw.tobytes()  # BYTE-equal, not allclose
+        # the raw counts ride along for the BM25 ranker
+        assert stream.count is not None and stream.doc_lengths is not None
+
+
+def test_chunked_ingest_ordering_contract():
+    """The pipeline's discipline, pinned: commit only ever runs with
+    nothing in flight, checkpoints drain-then-commit-then-save, and depth
+    bounds the in-flight window."""
+    log: list[str] = []
+    inflight = [0]
+    due = {"flag": False}
+
+    def launch(i):
+        inflight[0] += 1
+        assert inflight[0] <= 3  # depth 2 -> at most depth+1 briefly
+        log.append(f"launch{i}")
+        if i == 3:
+            due["flag"] = True
+        return i
+
+    def drain(i):
+        inflight[0] -= 1
+        log.append(f"drain{i}")
+
+    def commit():
+        assert inflight[0] == 0, "commit with launches in flight"
+        log.append("commit")
+
+    def save():
+        log.append("ckpt")
+        due["flag"] = False
+
+    dataflow.chunked_ingest(
+        range(6), launch=launch, drain=drain, commit=commit, depth=2,
+        checkpoint_due=lambda: due["flag"], save_checkpoint=save,
+        prefetch_source=False,
+    )
+    assert log[-1] == "commit"
+    assert "ckpt" in log
+    assert log.index("ckpt") == log.index("commit") + 1  # commit before save
+    assert [x for x in log if x.startswith("launch")] == [
+        f"launch{i}" for i in range(6)
+    ]
+    assert sorted(x for x in log if x.startswith("drain")) == [
+        f"drain{i}" for i in range(6)
+    ]
+
+
+def test_workload_fixpoints_survive_device_loss_via_shared_salvage():
+    """The marginal-cost resilience claim: the NEW workloads inherit the
+    single-chip device-loss salvage (dataflow.fixpoint.make_cpu_salvage)
+    without wiring of their own — a device-targeted loss at each
+    workload's delta-sync site recovers to the uninterrupted result."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.components import (
+        run_components,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.hits import run_hits
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.ppr import (
+        run_ppr_batch,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+        ComponentsConfig,
+        HitsConfig,
+    )
+
+    g = synthetic_powerlaw(300, 1200, seed=17)
+    pcfg = PageRankConfig(iterations=20, **GRAPH_KW)
+    queries = [[int(g.node_ids[0])], [int(g.node_ids[5])]]
+    base_ppr = run_ppr_batch(g, pcfg, queries)
+    base_hits = run_hits(g, HitsConfig(iterations=30, tol=0.0))
+    base_cc = run_components(g, ComponentsConfig())
+
+    elastic.reset_health()
+    try:
+        with chaos.inject("ppr_delta_sync:device_lost@dev:0"):
+            m = MetricsRecorder()
+            ppr = run_ppr_batch(g, pcfg, queries, metrics=m)
+        assert any(r.get("event") == "degraded" and r.get("ladder") == "cpu"
+                   for r in m.records)
+        np.testing.assert_allclose(ppr.ranks, base_ppr.ranks, atol=1e-6)
+
+        elastic.reset_health()
+        with chaos.inject("hits_delta_sync:device_lost@dev:0"):
+            h = run_hits(g, HitsConfig(iterations=30, tol=0.0))
+        np.testing.assert_allclose(h.hubs, base_hits.hubs, atol=1e-6)
+
+        elastic.reset_health()
+        with chaos.inject("cc_delta_sync:device_lost@dev:0"):
+            c = run_components(g, ComponentsConfig())
+        np.testing.assert_array_equal(c.labels, base_cc.labels)
+
+        # a loss first surfacing at the RESULT pull (no segment dispatch
+        # left to catch it) walks the shared pull-salvage rung
+        elastic.reset_health()
+        with chaos.inject("ppr_result_pull:device_lost@dev:0"):
+            ppr2 = run_ppr_batch(g, pcfg, queries)
+        np.testing.assert_allclose(ppr2.ranks, base_ppr.ranks, atol=1e-6)
+    finally:
+        elastic.reset_health()
+
+
+def test_chunked_ingest_chaos_stays_invisible():
+    """Transient chunk-drain faults through the shared ingest wiring are
+    absorbed by the executor exactly as before the port."""
+    docs = [f"doc{i} token{i % 4} word" for i in range(24)]
+    chunks = [docs[i:i + 4] for i in range(0, len(docs), 4)]
+    cfg = TfidfConfig(vocab_bits=9, chunk_tokens=32, prefetch=1)
+    base = run_tfidf_streaming(iter(chunks), cfg)
+    m = MetricsRecorder()
+    with chaos.inject("tfidf_chunk_sync:fail@2"):
+        out = run_tfidf_streaming(iter(chunks), cfg, metrics=m)
+    assert any(r.get("event") == "retry" for r in m.records)
+    assert out.weight.tobytes() == base.weight.tobytes()

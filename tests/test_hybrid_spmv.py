@@ -41,7 +41,7 @@ F64 = dict(dangling="redistribute", init="uniform", dtype="float64")
 
 def _spmv(graph, impl: str, w: np.ndarray) -> np.ndarray:
     dg = ops.put_graph(graph, "float64", layout=ops.layout_for_impl(impl))
-    return np.asarray(ops._spmv(dg, jnp.asarray(w), graph.n_nodes, impl))
+    return np.asarray(ops.spmv(dg, jnp.asarray(w), graph.n_nodes, impl))
 
 
 def _assert_impls_match_segment(graph, w=None):

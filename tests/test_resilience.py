@@ -277,6 +277,58 @@ def test_pagerank_device_loss_degrades_to_cpu():
     np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
 
 
+@pytest.fixture
+def fresh_health():
+    from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
+
+    elastic.reset_health()
+    yield
+    elastic.reset_health()
+
+
+@pytest.mark.parametrize(
+    "site", ["pagerank_delta_sync", "pagerank_ckpt_pull",
+             "pagerank_result_pull"],
+)
+def test_pagerank_single_chip_device_lost_at_pull_sites(tmp_path, site,
+                                                        fresh_health):
+    """ISSUE 9 carried-forward satellite: a single-chip device loss first
+    surfacing at a checkpoint-pull-class site (the delta fetch, the
+    checkpoint pull, the final result pull) used to dead-end — the CPU
+    rung re-pulled the carry that died with the device.  Now those sites
+    walk the same elastic salvage the sharded pull uses: acknowledge the
+    loss, reload the newest snapshot, re-run only the uncommitted span on
+    the CPU backend, and finish with ranks matching an uninterrupted run."""
+    g = synthetic_powerlaw(800, 3200, seed=7)
+    base = run_pagerank(g, PageRankConfig(iterations=12, **GRAPH_KW))
+    cfg = PageRankConfig(iterations=12, checkpoint_every=4,
+                         checkpoint_dir=str(tmp_path / "ck"), **GRAPH_KW)
+    m = MetricsRecorder()
+    with chaos.inject(f"{site}:device_lost@dev:0"):
+        res = run_pagerank(g, cfg, metrics=m)
+    degraded = [r for r in m.records if r.get("event") == "degraded"]
+    assert degraded and degraded[0]["ladder"] == "cpu"
+    assert "salvage_iter" in degraded[0]  # the elastic salvage, not the
+    # legacy pull-the-dead-carry rung
+    assert res.iterations == 12
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+
+
+def test_pagerank_single_chip_device_lost_without_checkpoint(fresh_health):
+    """The salvage rung without any checkpoint dir: falls back to the
+    init vector and re-runs the whole span on CPU — still converging to
+    the uninterrupted ranks (nothing to salvage means recompute, not
+    fail)."""
+    g = synthetic_powerlaw(500, 2000, seed=3)
+    cfg = PageRankConfig(iterations=8, **GRAPH_KW)
+    base = run_pagerank(g, cfg)
+    m = MetricsRecorder()
+    with chaos.inject("pagerank_delta_sync:device_lost@dev:0"):
+        res = run_pagerank(g, cfg, metrics=m)
+    assert any(r.get("event") == "degraded" for r in m.records)
+    np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+
+
 def test_pagerank_exhausted_resumes_from_checkpoint(tmp_path):
     """The full ladder: mid-run device loss with the CPU rung also failing
     -> ResilienceExhausted carrying the checkpoint -> a resume run (no

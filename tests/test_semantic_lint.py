@@ -297,6 +297,33 @@ def test_registry_covers_every_jit_surface():
     assert f"{pkg}/ops/tfidf.py" in modules
     assert f"{pkg}/parallel/pagerank_sharded.py" in modules
     assert f"{pkg}/parallel/tfidf_sharded.py" in modules
+    assert f"{pkg}/dataflow/ppr.py" in modules
+    assert f"{pkg}/dataflow/hits.py" in modules
+    assert f"{pkg}/dataflow/components.py" in modules
+    assert f"{pkg}/dataflow/bm25.py" in modules
+
+
+def test_every_dataflow_jit_surface_is_registered():
+    """ISSUE 9 CI gate: a module under dataflow/ that creates a jit entry
+    point (lexically: any ``jax.jit`` use) without a registry entry — or
+    at least a ``watch`` hook from one — fails tier-1.  A new workload
+    cannot ship outside the tier-2 recompile/promotion/transfer gates and
+    the tier-3 intensity/pad/donation budgets."""
+    pkg = "page_rank_and_tfidf_using_apache_spark_tpu"
+    covered = {ep.module for ep in ENTRY_POINTS}
+    covered |= {w for ep in ENTRY_POINTS for w in ep.watch}
+    missing = []
+    for p in sorted((REPO / pkg / "dataflow").glob("*.py")):
+        if "jax.jit" not in p.read_text(encoding="utf-8"):
+            continue
+        rel = f"{pkg}/dataflow/{p.name}"
+        if rel not in covered:
+            missing.append(rel)
+    assert not missing, (
+        f"dataflow modules with jit entry points but no analysis/registry.py "
+        f"coverage: {missing} — declare an EntryPoint (see README 'Static "
+        "analysis') before shipping the workload"
+    )
 
 
 def test_sharded_entries_trace_the_shrink_chain():

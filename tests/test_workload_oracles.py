@@ -1,0 +1,260 @@
+"""External-oracle tests for the four ISSUE 9 dataflow workloads:
+networkx ``pagerank(personalization=)`` / ``hits`` /
+``connected_components`` on small Zipf graphs, plus a hand-computed BM25
+fixture beside the existing sklearn TF-IDF oracle — value-level pins,
+not just orderings.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+nx = pytest.importorskip("networkx")
+
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.bm25 import (  # noqa: E402
+    bm25_from_tfidf,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.components import (  # noqa: E402
+    run_components,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.hits import run_hits  # noqa: E402
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.ppr import (  # noqa: E402
+    run_ppr_batch,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.io import synthetic_powerlaw  # noqa: E402
+from page_rank_and_tfidf_using_apache_spark_tpu.io.text import tokenize  # noqa: E402
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf  # noqa: E402
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (  # noqa: E402
+    Bm25Config,
+    ComponentsConfig,
+    HitsConfig,
+    PageRankConfig,
+    TfidfConfig,
+)
+
+
+def _nx_digraph(graph):
+    G = nx.DiGraph()
+    G.add_nodes_from(range(graph.n_nodes))
+    G.add_edges_from(zip(graph.src.tolist(), graph.dst.tolist()))
+    return G
+
+
+def _compact(graph, original_id: int) -> int:
+    return int(np.searchsorted(graph.node_ids, original_id))
+
+
+# --------------------------------------------- personalized PageRank
+
+
+def test_ppr_batch_matches_networkx_personalization():
+    """Each query of a batched personalized PageRank run matches
+    networkx.pagerank(personalization=) on the same Zipf graph — the
+    vmap axis changes the schedule, never a value."""
+    g = synthetic_powerlaw(150, 700, seed=11)
+    G = _nx_digraph(g)
+    queries = [
+        [int(g.node_ids[0])],
+        [int(g.node_ids[3]), int(g.node_ids[9])],
+        [int(g.node_ids[7]), int(g.node_ids[7]), int(g.node_ids[2])],
+    ]
+    cfg = PageRankConfig(iterations=500, tol=1e-12, dangling="redistribute",
+                         init="uniform", dtype="float64")
+    res = run_ppr_batch(g, cfg, queries)
+    assert res.ranks.shape == (len(queries), g.n_nodes)
+    for qi, q in enumerate(queries):
+        pers = {i: 0.0 for i in range(g.n_nodes)}
+        for oid in q:  # duplicates accumulate, matching restart_vector
+            pers[_compact(g, oid)] += 1.0 / len(q)
+        want = nx.pagerank(G, alpha=0.85, personalization=pers,
+                           tol=1e-12, max_iter=1000)
+        got = res.ranks[qi] / res.ranks[qi].sum()
+        np.testing.assert_allclose(
+            got, np.array([want[i] for i in range(g.n_nodes)]), atol=1e-8
+        )
+
+
+def test_ppr_batch_queries_differ_and_concentrate():
+    """Sanity on the personalization semantics: a query's restart nodes
+    hold more mass under their own query than under a different one."""
+    g = synthetic_powerlaw(200, 900, seed=4)
+    q0, q1 = [int(g.node_ids[0])], [int(g.node_ids[50])]
+    res = run_ppr_batch(
+        g, PageRankConfig(iterations=100, tol=1e-10,
+                          dangling="redistribute", init="uniform"),
+        [q0, q1],
+    )
+    i0, i1 = _compact(g, q0[0]), _compact(g, q1[0])
+    assert res.ranks[0][i0] > res.ranks[1][i0]
+    assert res.ranks[1][i1] > res.ranks[0][i1]
+
+
+# ----------------------------------------------------------------- HITS
+
+
+def test_hits_matches_networkx():
+    g = synthetic_powerlaw(150, 700, seed=13)
+    res = run_hits(g, HitsConfig(iterations=1000, tol=1e-13, dtype="float64"))
+    nh, na = nx.hits(_nx_digraph(g), max_iter=2000, tol=1e-13)
+    np.testing.assert_allclose(
+        res.hubs, np.array([nh[i] for i in range(g.n_nodes)]), atol=1e-6
+    )
+    np.testing.assert_allclose(
+        res.authorities, np.array([na[i] for i in range(g.n_nodes)]),
+        atol=1e-6,
+    )
+    assert abs(res.hubs.sum() - 1.0) < 1e-9
+    assert abs(res.authorities.sum() - 1.0) < 1e-9
+
+
+# ----------------------------------------------------- connected components
+
+
+@pytest.mark.parametrize("seed", [1, 9, 42])
+def test_components_match_networkx(seed):
+    g = synthetic_powerlaw(300, 600, seed=seed)
+    res = run_components(g, ComponentsConfig())
+    want = sorted(
+        sorted(c) for c in nx.connected_components(
+            _nx_digraph(g).to_undirected()
+        )
+    )
+    got = sorted(sorted(c) for c in res.groups())
+    assert got == want
+    assert res.n_components == len(want)
+    # labels are canonical: the smallest member id of the component
+    for comp in got:
+        assert all(res.labels[i] == comp[0] for i in comp)
+
+
+def test_components_isolated_nodes_and_empty():
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import from_edges
+
+    g = from_edges(np.array([0, 1, 5]), np.array([1, 0, 6]))
+    res = run_components(g, ComponentsConfig())
+    assert res.n_components == 2
+    assert res.converged
+
+
+def test_components_iteration_cap_flags_non_convergence():
+    """A chain longer than the round cap cannot reach the fixpoint: the
+    result must say so instead of silently over-segmenting."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import from_edges
+
+    n = 40
+    g = from_edges(np.arange(n - 1), np.arange(1, n))  # a path graph
+    res = run_components(g, ComponentsConfig(iterations=3))
+    assert not res.converged
+    assert res.n_components > 1  # the over-segmentation the flag warns of
+    full = run_components(g, ComponentsConfig())
+    assert full.converged and full.n_components == 1
+
+
+# ----------------------------------------------------------------- BM25
+
+
+def test_bm25_matches_hand_computed_fixture():
+    """Hand-computed Okapi BM25 (Lucene idf) on a tiny corpus — the
+    formula re-derived in numpy from first principles next to the sklearn
+    TF-IDF oracle (tests/test_tfidf_oracle.py)."""
+    docs = [
+        "apollo guidance computer",
+        "apollo program",
+        "guidance law control systems",
+        "computer science computer architecture computer",
+        "the moon landing apollo apollo",
+    ]
+    cfg = TfidfConfig(vocab_bits=12)
+    out = run_tfidf(docs, cfg)
+    k1, b = 1.7, 0.6
+    got = bm25_from_tfidf(out, Bm25Config(k1=k1, b=b))
+    assert got.shape == out.weight.shape
+
+    n = len(docs)
+    dls = np.array([len(tokenize(d)) for d in docs], float)
+    avgdl = dls.mean()
+    # independent hand computation per (doc, term) COO row
+    for row in range(out.nnz):
+        d, t, c = int(out.doc[row]), int(out.term[row]), float(out.count[row])
+        df = float(out.df[t])
+        idf = np.log(1.0 + (n - df + 0.5) / (df + 0.5))
+        want = idf * c * (k1 + 1) / (c + k1 * (1 - b + b * dls[d] / avgdl))
+        assert abs(got[row] - want) < 1e-5, (row, got[row], want)
+    # saturation: a count-3 pair must weigh LESS than 3x the weight the
+    # same (term, doc-length) pair would get at count 1 — the k1 term-
+    # frequency damping, checked against the hand formula
+    crow = [r for r in range(out.nnz) if int(out.doc[r]) == 3
+            and float(out.count[r]) == 3.0]
+    assert crow, "fixture expects a count-3 pair"
+    r = crow[0]
+    df = float(out.df[int(out.term[r])])
+    idf = np.log(1.0 + (n - df + 0.5) / (df + 0.5))
+    w1 = idf * (k1 + 1) / (1 + k1 * (1 - b + b * dls[3] / avgdl))
+    assert got[r] < 3 * w1 * 0.75  # well below linear growth
+
+
+def test_bm25_requires_counts():
+    import dataclasses
+
+    docs = ["a b", "b c"]
+    out = run_tfidf(docs, TfidfConfig(vocab_bits=8))
+    stripped = dataclasses.replace(out, count=None)
+    with pytest.raises(ValueError, match="raw counts"):
+        bm25_from_tfidf(stripped)
+
+
+def test_bm25_serving_ab_ranker_byte_stable(tmp_path):
+    """The served BM25 path: index bundles BM25 weights, per-request
+    ranker selection returns BM25-ordered results byte-equal to scoring
+    the BM25 weight table directly through score_query."""
+    import jax.numpy as jnp
+
+    from page_rank_and_tfidf_using_apache_spark_tpu import serving
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as tops
+
+    docs = ["apollo guidance computer", "apollo program apollo",
+            "guidance law", "computer science computer"]
+    cfg = TfidfConfig(vocab_bits=10)
+    out = run_tfidf(docs, cfg)
+    serving.save_index(str(tmp_path), out, cfg, bm25=Bm25Config())
+    idx = serving.load_index(str(tmp_path))
+    assert idx.bm25_weight is not None
+    assert idx.extra["has_bm25"] and idx.extra["bm25_config"]["k1"] == 1.5
+
+    with serving.TfidfServer(idx, serving.ServeConfig(top_k=4)) as srv:
+        scores, docs_idx = srv.query(["apollo"], ranker="bm25")
+        qt, qw = srv.make_query(["apollo"])
+        qvec = np.zeros(idx.vocab_size, idx.weight.dtype)
+        np.add.at(qvec, qt, qw)
+        res = tops.TfidfResult(
+            doc=jnp.asarray(idx.doc), term=jnp.asarray(idx.term),
+            weight=jnp.asarray(idx.bm25_weight),
+            n_pairs=jnp.asarray(idx.nnz),
+            valid=jnp.ones(idx.nnz, idx.weight.dtype),
+            idf=jnp.asarray(idx.idf), df=jnp.asarray(idx.df),
+        )
+        want_s, want_i = tops.score_query(
+            res, jnp.asarray(qvec), n_docs=idx.n_docs, k=4
+        )
+        assert scores.tobytes() == np.asarray(want_s).tobytes()
+        assert docs_idx.tobytes() == np.asarray(want_i).tobytes()
+        # and the two rankers genuinely differ on this corpus
+        t_scores, _ = srv.query(["apollo"], ranker="tfidf")
+        assert t_scores.tobytes() != scores.tobytes()
+
+
+def test_bm25_ranker_refused_without_weights(tmp_path):
+    from page_rank_and_tfidf_using_apache_spark_tpu import serving
+
+    docs = ["a b c", "b c d"]
+    cfg = TfidfConfig(vocab_bits=8)
+    out = run_tfidf(docs, cfg)
+    serving.save_index(str(tmp_path), out, cfg)  # no bm25=
+    idx = serving.load_index(str(tmp_path))
+    assert idx.bm25_weight is None
+    with serving.TfidfServer(idx, serving.ServeConfig(top_k=2)) as srv:
+        with pytest.raises(ValueError, match="no BM25 weights"):
+            srv.submit(["a"], ranker="bm25")
+        with pytest.raises(ValueError, match="unknown ranker"):
+            srv.submit(["a"], ranker="pagerank")

@@ -97,3 +97,73 @@ assert not rep["exhausted"], rep["exhausted"]
 print("device_lost scenario: OK — both sharded runners survived via "
       f"mesh-shrink ({[s['site'] for s in shrinks]})")
 EOF
+
+# ---------------------------------------------------------------------------
+# dataflow-core fixpoint scenario (ISSUE 9): the fixpoint primitive that
+# every workload now runs over (dataflow.fixpoint.iterate inside the jit,
+# dataflow.fixpoint.run_segments + the elastic ladder on the host side) is
+# exercised AS a tolerance (while-loop) fixpoint on a 2-device mesh with
+# logical device 1 chaos-killed mid-run: the run must finish via the
+# mesh-shrink rung with ranks matching the uninterrupted fixpoint, and a
+# batched personalized-PageRank fixpoint must survive a single-chip
+# device loss at its delta-sync site through the same shared wiring.
+echo "== chaos: dataflow fixpoint under device_lost (2-device mesh) =="
+dflow_dir=$(mktemp -d)
+trap 'rm -rf "$scenario_dir" "$dflow_dir"' EXIT
+env -u PALLAS_AXON_POOL_IPS \
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    GRAFT_TRACE_DIR="$dflow_dir" \
+    SCENARIO_DIR="$dflow_dir" \
+    python - <<'EOF'
+import glob
+import os
+import sys
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.ppr import run_ppr_batch
+from page_rank_and_tfidf_using_apache_spark_tpu.io import synthetic_powerlaw
+from page_rank_and_tfidf_using_apache_spark_tpu.parallel import run_pagerank_sharded
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
+
+sys.path.insert(0, "tools")  # chaos.sh runs from the repo root
+import trace_report
+
+kw = dict(dangling="redistribute", init="uniform", dtype="float32")
+g = synthetic_powerlaw(800, 3200, seed=9)
+# tolerance run: the while-loop branch of dataflow.fixpoint.iterate
+cfg = PageRankConfig(iterations=200, tol=1e-8, **kw)
+base = run_pagerank_sharded(g, cfg, n_devices=2)
+queries = [[int(g.node_ids[0])], [int(g.node_ids[10])]]
+base_ppr = run_ppr_batch(g, PageRankConfig(iterations=30, **kw), queries)
+
+os.environ["GRAFT_CHAOS"] = "*:device_lost@dev:1"
+run = obs.start_run("chaos_dataflow_fixpoint", os.environ["SCENARIO_DIR"])
+res = run_pagerank_sharded(g, cfg, n_devices=2)
+np.testing.assert_allclose(res.ranks, base.ranks, atol=1e-6)
+
+# single-chip dataflow fixpoint: device 0 dies at the PPR delta sync ->
+# the checkpoint-salvage rung re-runs on the CPU backend
+elastic.reset_health()
+os.environ["GRAFT_CHAOS"] = "ppr_delta_sync:device_lost@dev:0"
+ppr = run_ppr_batch(g, PageRankConfig(iterations=30, **kw), queries)
+np.testing.assert_allclose(ppr.ranks, base_ppr.ranks, atol=1e-6)
+obs.end_run()
+
+rep = trace_report.report(glob.glob(os.path.join(
+    os.environ["SCENARIO_DIR"], "chaos_dataflow_fixpoint.*.trace.jsonl"
+))[0])
+shrinks = rep["mesh_shrinks"]
+assert len(shrinks) == 1 and (
+    shrinks[0]["devices_old"], shrinks[0]["devices_new"]) == (2, 1), shrinks
+# the INNER guarded delta fetch exhausts by design (its own ladder has no
+# rungs — the outer segment ladder owns recovery); anything else
+# exhausting means the salvage rung failed
+assert set(rep["exhausted"]) <= {"ppr_delta_sync"}, rep["exhausted"]
+assert any(d == "ppr_step" for d in rep["degraded"]), rep["degraded"]
+print("dataflow fixpoint scenario: OK — sharded tol-fixpoint shrank 2->1 "
+      "and the batched-PPR fixpoint salvaged through the shared ladder")
+EOF

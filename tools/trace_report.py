@@ -219,6 +219,25 @@ def report(path: str) -> dict[str, Any]:
         site = str(s["site"] or "?")
         shrink_sites[site] = shrink_sites.get(site, 0) + 1
 
+    # Strategy decisions (ISSUE 9 satellite): auto_select_strategy and
+    # plan_partition publish WHAT was chosen and the measured inputs that
+    # drove the choice — "why did this run pick hybrid" is answerable
+    # from the artifact alone.
+    strategy = {
+        "decisions": [
+            {k: v for k, v in e.items() if k not in ("kind", "t", "thread")}
+            for e in events
+            if e["kind"] in ("strategy_decision", "auto_strategy")
+        ],
+        "plans": [
+            {k: v for k, v in e.items() if k not in ("kind", "t", "thread")}
+            for e in events
+            if e["kind"] == "partition_plan"
+        ],
+    }
+    if not strategy["decisions"] and not strategy["plans"]:
+        strategy = None
+
     last_incomplete = None
     if incomplete:
         deepest = max(incomplete, key=lambda r: r["t0"])
@@ -291,6 +310,7 @@ def report(path: str) -> dict[str, Any]:
         "exhausted": _tally(events, "exhausted"),
         "mesh_shrinks": mesh_shrinks,
         "shrinks": shrink_sites,
+        "strategy": strategy,
         "checkpoints": sum(e["kind"] == "checkpoint_save" for e in events),
         "last_incomplete": last_incomplete,
         "summary": run_end.get("summary") if run_end else None,
@@ -465,6 +485,28 @@ def render_human(rep: dict[str, Any]) -> str:
             f"({s['ladder']}) at +{s['t_rel']:.2f}s, {s['secs']:.3f}s "
             f"rebuild [{s['site']}]{mark}"
         )
+    if rep.get("strategy"):
+        st = rep["strategy"]
+        for d in st["decisions"]:
+            chosen = d.get("chosen", "?")
+            reason = d.get("reason", "")
+            inputs = ", ".join(
+                f"{k}={d[k]}"
+                for k in ("devices", "nodes", "edges", "node_state_bytes",
+                          "head_edge_frac")
+                if k in d
+            )
+            lines.append(
+                f"strategy: chose {chosen!r}"
+                + (f" — {reason}" if reason else "")
+                + (f" ({inputs})" if inputs else "")
+            )
+        for p in st["plans"]:
+            lines.append(
+                f"partition plan: {p.get('strategy')} d={p.get('devices')} "
+                f"pad_frac={p.get('pad_frac')} block={p.get('block')} "
+                f"e_dev={p.get('e_dev')}"
+            )
     if rep["checkpoints"]:
         lines.append(f"checkpoints saved: {rep['checkpoints']}")
     if rep["last_incomplete"]:
