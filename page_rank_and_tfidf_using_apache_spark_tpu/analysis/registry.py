@@ -925,6 +925,10 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
             f"{_PKG}/parallel/mesh.py",
             f"{_PKG}/parallel/collectives.py",
             f"{_PKG}/parallel/compat.py",
+            # the host loop is the staged pipeline now (ISSUE 10): a
+            # change to the staging/commit discipline must re-verify the
+            # sharded contracts (collective budget, shrink-chain compiles)
+            f"{_PKG}/dataflow/ingest.py",
         ),
         axes=("data",),
         # exactly the DF psum — the one reduceByKey of the ingest step

@@ -1127,3 +1127,65 @@ def _use_is_single_element(use: ast.Name, ctx: FileContext) -> bool:
         ):
             return True
     return False
+
+
+# --------------------------------------------------------------------------
+# 12. sync-put-in-ingest-loop
+# --------------------------------------------------------------------------
+
+# Directory components whose per-chunk H2D transfers must route through the
+# staging API (dataflow.ingest.staged_put / the chunked_ingest stage
+# closure): a raw jax.device_put inside an ingest loop body serializes the
+# pipeline — the transfer blocks the thread that should be dispatching
+# chunk N while chunk N+1 transfers — and sits outside the
+# ``ingest_h2d_put`` chaos/retry site, so device loss during the put
+# bypasses the pipeline's recovery point.
+_INGEST_PUT_DIRS = frozenset({"dataflow", "models", "parallel"})
+_STAGED_PUT_LEAF = "staged_put"
+
+
+def _under_staged_put(node: ast.AST, ctx: FileContext) -> bool:
+    """Is ``node`` lexically inside an argument of a ``staged_put(...)``
+    call (any alias path: ``staged_put`` / ``dflow.staged_put`` /
+    ``ingest.staged_put``)?  The conventional shape is a lambda/closure
+    handed to staged_put, whose body issues the raw puts."""
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.Call):
+            cname = call_name(cur)
+            if cname and cname.rsplit(".", 1)[-1] == _STAGED_PUT_LEAF:
+                return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
+@rule(
+    "sync-put-in-ingest-loop",
+    "raw jax.device_put inside a loop body in dataflow/, models/ or "
+    "parallel/ outside the staging API (dataflow.ingest.staged_put) — "
+    "per-chunk H2D transfers must run on the pipeline's staging stage so "
+    "they overlap compute, retry transients, and surface device loss at "
+    "the pipeline's recovery point (ratchet stays at zero: migrate, "
+    "don't baseline)",
+)
+def check_sync_put_in_ingest_loop(ctx: FileContext) -> Iterator[Hit]:
+    parts = ctx.relpath.split("/")
+    if not (set(parts[:-1]) & _INGEST_PUT_DIRS):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node) != "jax.device_put":
+            continue
+        if not ctx.enclosing_loops(node):
+            continue
+        if _under_staged_put(node, ctx):
+            continue
+        yield (
+            node,
+            "raw jax.device_put inside a loop body — route the transfer "
+            "through dataflow.ingest.staged_put (or the chunked_ingest "
+            "stage closure) so it runs on the staging stage: overlapped "
+            "with compute, retried on transients, and recoverable at the "
+            "pipeline's recovery point on device loss",
+        )

@@ -63,6 +63,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefetch", type=int, default=2,
                    help="tokenizer chunks to double-buffer ahead of device "
                         "compute (0 = serial)")
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="H2D-staged chunks the ingest transfer thread may "
+                        "hold in device memory — chunk N+1's device_put "
+                        "runs under chunk N's compute (0 = stage inline)")
+    p.add_argument("--pack-target", type=int, default=0, metavar="TOKENS",
+                   help="re-pack incoming chunks to ~TOKENS tokens each "
+                        "before padding, so half-full chunks stop paying "
+                        "full-cap compute (0 = keep the source chunking; "
+                        "resume runs must re-use the same value)")
     p.add_argument("--save-index", default=None, metavar="DIR",
                    help="serialize the result as the next servable index "
                         "version under DIR (serving/artifact.py) — the "
@@ -119,6 +128,8 @@ def _main(args) -> int:
         min_token_len=args.min_token_len,
         chunk_tokens=args.chunk_tokens,
         prefetch=args.prefetch,
+        pipeline_depth=args.pipeline_depth,
+        pack_target_tokens=args.pack_target,
         checkpoint_every=args.checkpoint_every,
         checkpoint_dir=args.checkpoint_dir,
     )

@@ -42,6 +42,30 @@ from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 
 
+def commit_barrier(
+    drain_all: Callable[[], None],
+    commit: Callable[[], None],
+    save_checkpoint: Callable[[], None] | None = None,
+) -> None:
+    """The drain-before-commit barrier of the staged ingest pipeline
+    (ISSUE 10): every in-flight launch drains, THEN device carry state is
+    pulled, THEN (optionally) the snapshot is written — so a checkpoint
+    can never hold carry contributions from chunks it does not record as
+    ingested, no matter how deep the H2D staging / in-flight windows run.
+
+    Lives here rather than in ``dataflow/ingest.py`` because it is the
+    ingest counterpart of the fixpoint checkpoint discipline above (a
+    segment must complete before its snapshot): one module owns "what a
+    commit point means" for both dataflow driver shapes.  The span makes
+    barrier stalls attributable in traces — time spent here is pipeline
+    drain, not compute."""
+    with obs.span("ingest.commit_barrier"):
+        drain_all()
+        commit()
+        if save_checkpoint is not None:
+            save_checkpoint()
+
+
 def default_delta(new, old):
     """L1 distance between successive carries — PageRank's convergence
     gauge, and a sane default for any single-array fixpoint."""

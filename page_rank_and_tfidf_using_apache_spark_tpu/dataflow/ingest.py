@@ -1,33 +1,94 @@
-"""``chunked_ingest`` — the dataflow core's bounded-source ingest primitive.
+"""``chunked_ingest`` — the dataflow core's staged, double-buffered
+bounded-source ingest pipeline.
 
-Spark correspondence: reading a partitioned input (``textFile`` →
-per-partition iterator chains) under a driver that tracks progress.  The
-TPU-native shape (SURVEY.md §5.7): a bounded host source feeding
-fixed-capacity padded device chunks through a once-compiled kernel, with
-a donated device-resident carry, bounded in-flight launches, and commit
-points (checkpoints) that only ever snapshot fully-drained state.
+Spark correspondence: a ``spark.streaming`` receiver chain — a receiver
+thread buffering input blocks, the block manager shipping them to
+executors, and the driver scheduling micro-batches over what has landed —
+under a driver that tracks progress.  The TPU-native shape (SURVEY.md
+§5.7): a bounded host source feeding fixed-capacity padded device chunks
+through a once-compiled kernel, with a donated device-resident carry,
+bounded in-flight launches, and commit points (checkpoints) that only
+ever snapshot fully-drained state.
 
-This module owns the three pieces every ingest path shares — the
-:func:`grow_chunk_cap` fixed-shape padding policy (moved here from
-``models/tfidf.py``, which re-exports it; the serving micro-batcher rides
-the same policy at ``min_bits=0``), the :func:`prefetched` background-
-thread source buffer, and the :func:`chunked_ingest` pipeline driver —
-so the streaming TF-IDF path in ``models/tfidf.py`` is now a thin
-program over this primitive (launch/drain/commit closures only), and the
-next chunked workload starts from the same wiring instead of copying the
-deque discipline.
+The pipeline is genuinely staged (ISSUE 10):
+
+    source ──► tokenize ──► H2D staging ──► compute ──► drain ─► commit
+               (``prefetch``   (``pipeline_depth``  (``prefetch``   (barrier)
+                thread,         transfer thread,     in-flight
+                bounded queue)  bounded queue)       launches)
+
+- the **tokenize** stage is the caller's source iterator run on a
+  background thread (:class:`Prefetched`) buffering up to ``prefetch``
+  chunks;
+- the **H2D staging** stage runs the caller's ``stage(item)`` closure —
+  which issues ``jax.device_put`` through :func:`staged_put` (chaos/retry
+  site ``ingest_h2d_put``) — on a transfer thread, holding at most
+  ``pipeline_depth`` staged chunks of device memory and exerting
+  backpressure on the tokenize queue;
+- the **compute** stage (``launch``) consumes pre-staged device buffers
+  only; up to ``prefetch`` launches stay in flight before the oldest is
+  drained;
+- **commit points** run behind a drain-before-commit barrier
+  (:func:`fixpoint.commit_barrier`), so checkpoints only ever snapshot
+  fully-drained state and the donated carry is pulled with nothing in
+  flight.
+
+Every stage opens its own obs span (``ingest.tokenize`` / ``ingest.h2d``
+/ ``ingest.compute``), and one ``ingest_overlap`` event plus an
+``h2d_overlap_frac`` gauge — the fraction of H2D staging wall time spent
+while chunk compute was in flight — are published per run, so
+trace_report can prove where the overlap lands from the artifact alone.
+
+Fault model: the two pipeline-internal sites (``ingest_h2d_put`` on the
+transfer thread, ``ingest_h2d_wait`` on the consumer side) retry
+transient faults like every guarded site but propagate persistent ones
+RAW (``resilience.executor.retry_transient``) to the single recovery
+point here: on failure the pipeline tears its threads down, collects
+every item that was staged/launched but never drained (plus the
+prefetchers' unconsumed buffers — nothing is ever silently dropped), and
+hands ``(exc, remaining, where)`` to the caller's ``recover`` hook.  The
+hook acknowledges the loss (elastic shrink for sharded meshes, CPU
+salvage for single-chip carries) and the pipeline restarts over the
+remaining items — committed chunks are never reprocessed, and the
+reprocessed span is byte-identical because it replays the same host
+arrays in the same order.
+
+This module owns :func:`grow_chunk_cap` (fixed-shape padding policy —
+``models/tfidf.py`` re-exports it; the serving micro-batcher rides it at
+``min_bits=0``), :func:`pack_doc_chunks` (the re-batching stage that
+fills compiled caps so padding stops taxing compute), the
+:class:`Prefetched` bounded background buffer, and the
+:func:`chunked_ingest` driver — so the streaming TF-IDF path in
+``models/tfidf.py`` and the sharded path in ``parallel/tfidf_sharded.py``
+are thin programs over one primitive.
 """
 
 from __future__ import annotations
 
 import collections
+import dataclasses
 import queue
+import re
 import threading
-from typing import Callable, Iterable, Iterator
+import time
+from typing import Any, Callable, Iterable, Iterator, Sequence
 
 import numpy as np
 
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import IngestConfig
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+
+# Chaos/retry sites of the pipeline's own stages (resilience/chaos.py
+# grammar: e.g. GRAFT_CHAOS="ingest_h2d_put:device_lost@dev:1").
+H2D_PUT_SITE = "ingest_h2d_put"
+H2D_WAIT_SITE = "ingest_h2d_wait"
+
+# A recovery loop that cannot make progress must terminate: every
+# legitimate recovery acknowledges a loss or shrinks the mesh, and no
+# real topology survives this many independent device losses.
+_MAX_RECOVERIES = 16
 
 
 def grow_chunk_cap(
@@ -51,53 +112,320 @@ def grow_chunk_cap(
     return cap, changed
 
 
-_QUEUE_END = object()
+_ALNUM_RUN = re.compile(r"[A-Za-z0-9]+")
 
 
-def prefetched(source: Iterator, depth: int) -> Iterator:
-    """Run ``source`` on a background thread, buffering up to ``depth``
-    items (SURVEY.md §5.7 double-buffered ingest).  Tokenizing is host
-    C++/numpy that releases the GIL, so it genuinely overlaps the XLA chunk
-    kernel.  Exceptions are forwarded and re-raised on the consumer side;
-    if the consumer abandons the generator (exception or early close), the
-    producer notices via a stop event and exits instead of blocking forever
-    on a full queue."""
-    q: queue.Queue = queue.Queue(maxsize=depth)
-    stop = threading.Event()
+def estimate_tokens(doc: str) -> int:
+    """Alphanumeric-run count — the exact split rule of the default
+    tokenizer (``io.text._TOKEN_RE``), so this is a true upper bound for
+    unigram vocabularies: ``min_token_len`` can only drop runs.  Cheap
+    enough to run over the raw corpus before tokenization (no string
+    allocation per token)."""
+    return sum(1 for _ in _ALNUM_RUN.finditer(doc))
 
-    def put(item) -> bool:
-        while not stop.is_set():
+
+def ngram_estimator(ngram: int) -> Callable[[str], int]:
+    """Token-count upper bound matching ``io.text.add_ngrams``: ``t``
+    unigram runs expand to ``t + (t-1) + ... + (t-n+1)`` tokens.  Still an
+    upper bound — ``min_token_len`` filtering happens before the ngram
+    join, so it can only shrink both terms."""
+    if ngram <= 1:
+        return estimate_tokens
+
+    def estimate(doc: str) -> int:
+        t = estimate_tokens(doc)
+        return sum(max(t - k + 1, 0) for k in range(1, ngram + 1))
+
+    return estimate
+
+
+def pack_doc_chunks(
+    doc_chunks: Iterable[Sequence[str]],
+    target_tokens: int,
+    *,
+    estimate: Callable[[str], int] = estimate_tokens,
+) -> Iterator[list[str]]:
+    """The re-batching stage of the ingest pipeline: regroup documents so
+    each emitted chunk carries ~``target_tokens`` tokens (documents never
+    split — per-chunk run-length DF stays exact), turning a badly sized
+    source chunking into cap-filling chunks.
+
+    Why it matters: the chunk kernel compiles for a fixed power-of-two
+    capacity and sorts/reduces the PADDED arrays, so a stream of
+    one-third-full chunks pays ~3x the compute of the batch pipeline —
+    exactly the BENCH_r07 streaming-vs-batch gap (92k-token chunks padded
+    to a 2^18 cap).  Packing fills the cap to within one document.
+
+    Deterministic for a given source + target, so checkpoint chunk
+    indices stay valid across resume runs (``chunk_index`` counts PACKED
+    chunks; resume must re-pack with the same target).
+    """
+    target = max(int(target_tokens), 1)
+    cur: list[str] = []
+    est = 0
+    for chunk in doc_chunks:
+        for doc in chunk:
+            e = max(int(estimate(doc)), 1)
+            if cur and est + e > target:
+                yield cur
+                cur, est = [], 0
+            cur.append(doc)
+            est += e
+    if cur:
+        yield cur
+
+
+def staged_put(put: Callable[[], Any], *,
+               metrics: MetricsRecorder | None = None) -> Any:
+    """Issue one H2D transfer under the staging discipline: the
+    ``ingest_h2d_put`` chaos/retry site — transient faults retried with
+    backoff, persistent faults (device loss) propagated RAW to the
+    pipeline's recovery point (``chunked_ingest(recover=...)``), which
+    owns the shrink/salvage.  Every per-chunk ``jax.device_put`` in an
+    ingest loop must route through this (lint rule
+    ``sync-put-in-ingest-loop``)."""
+    return rx.retry_transient(put, site=H2D_PUT_SITE, metrics=metrics)
+
+
+# ------------------------------------------------------------- prefetcher
+
+
+class _Item:
+    __slots__ = ("item",)
+
+    def __init__(self, item):
+        self.item = item
+
+
+class _Raised:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class _End:
+    pass
+
+
+_END = _End()
+
+
+class Prefetched:
+    """Bounded background-thread buffer over an iterator, with an explicit
+    poison/close protocol (ISSUE 10 satellite):
+
+    - up to ``depth`` items are produced ahead on a daemon thread; a full
+      queue backpressures the producer;
+    - a producer exception travels through the queue and re-raises on the
+      consumer side WITH the original traceback (the exception object's
+      ``__traceback__`` still points at the producer frames);
+    - :meth:`close` shuts the producer down promptly even when it is
+      blocked on a full queue, and preserves every item the consumer
+      never saw: :meth:`leftover` (+ the still-held ``source`` iterator)
+      lets a recovery path resume the stream with zero loss — an item the
+      producer had in hand when the close hit is parked, never dropped.
+
+    Abandoning the iterator without ``close()`` (the legacy generator
+    wrapper closes in its ``finally``) leaves only a daemon thread that
+    exits at its next queue poll.
+    """
+
+    def __init__(self, source: Iterator, depth: int, *,
+                 name: str = "ingest-source"):
+        self.source = source
+        self._q: queue.Queue = queue.Queue(maxsize=max(int(depth), 1))
+        self._stop = threading.Event()
+        self._orphans: list = []
+        self._leftover: list = []
+        self._raised: list = []
+        self._closed = False
+        self._finished = False
+        self.thread = threading.Thread(target=self._produce, name=name,
+                                       daemon=True)
+        self.thread.start()
+
+    def _put(self, env) -> bool:
+        while not self._stop.is_set():
             try:
-                q.put(item, timeout=0.1)
+                self._q.put(env, timeout=0.05)
                 return True
             except queue.Full:
                 continue
         return False
 
-    def producer() -> None:
+    def _produce(self) -> None:
         try:
-            for item in source:
-                if not put(item):
+            for item in self.source:
+                if not self._put(_Item(item)):
+                    # close() hit while this item was in hand: park it for
+                    # leftover() — a recovery path must not lose it.
+                    # Only this thread writes the parking lists, and
+                    # close() joins before anyone reads them.
+                    self._orphans.append(item)  # graftlint: disable=unsynced-thread-state (producer-only write; close() joins before any read)
                     return
         except BaseException as exc:  # noqa: BLE001 — forwarded to consumer
-            put(exc)
+            if not self._put(_Raised(exc)):
+                # close() hit while the exception was in hand: park it —
+                # a _StageFailure carries the casualty item, which a
+                # recovery path must still salvage (raised())
+                self._raised.append(exc)  # graftlint: disable=unsynced-thread-state (producer-only write; close() joins before any read)
         else:
-            put(_QUEUE_END)
+            self._put(_END)
 
-    thread = threading.Thread(target=producer, name="ingest-source",
-                              daemon=True)
-    thread.start()
-    try:
+    def __iter__(self) -> "Prefetched":
+        return self
+
+    def __next__(self):
+        if self._closed or self._finished:
+            raise StopIteration
+        env = self._q.get()
+        if env is _END:
+            self._finished = True
+            self.thread.join()
+            raise StopIteration
+        if isinstance(env, _Raised):
+            self._finished = True
+            self.thread.join()
+            raise env.exc.with_traceback(env.exc.__traceback__)
+        return env.item
+
+    def close(self) -> None:
+        """Poison the producer and reap it, preserving unconsumed items
+        (drains the queue so a producer blocked on a full one unblocks
+        immediately instead of timing out its poll)."""
+        if self._closed or self._finished:
+            self._closed = True
+            return
+        self._closed = True
+        self._stop.set()
+        left: list = []
         while True:
-            item = q.get()
-            if item is _QUEUE_END:
+            try:
+                env = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if not self.thread.is_alive():
+                    break
+                continue
+            if isinstance(env, _Item):
+                left.append(env.item)
+            elif isinstance(env, _Raised):
+                self._raised.append(env.exc)
+        self.thread.join()
+        while True:  # final sweep: a put may have landed before the exit
+            try:
+                env = self._q.get_nowait()
+            except queue.Empty:
                 break
-            if isinstance(item, BaseException):
-                raise item
+            if isinstance(env, _Item):
+                left.append(env.item)
+            elif isinstance(env, _Raised):
+                self._raised.append(env.exc)
+        left.extend(self._orphans)
+        self._leftover = left
+
+    def leftover(self) -> list:
+        """Items produced but never consumed, in stream order — valid
+        after :meth:`close`.  ``source`` may still hold more."""
+        return list(self._leftover)
+
+    def raised(self) -> list:
+        """Producer exceptions swept up by :meth:`close` before the
+        consumer ever saw them (the consumer died first) — a recovery
+        path must inspect these, or an item a failing producer had in
+        hand would vanish with its unread exception."""
+        return list(self._raised)
+
+
+def prefetched(source: Iterator, depth: int) -> Iterator:
+    """Legacy generator wrapper over :class:`Prefetched` (same contract:
+    background production up to ``depth`` ahead, producer exceptions
+    re-raised consumer-side, clean producer shutdown when the consumer
+    abandons the generator early)."""
+    pf = Prefetched(iter(source), depth)
+    try:
+        for item in pf:
             yield item
     finally:
-        stop.set()
-        thread.join()
+        pf.close()
+
+
+# ---------------------------------------------------------------- pipeline
+
+
+class _IteratorRaised(BaseException):
+    """Carrier for an exception raised from INSIDE the staged iterator at
+    the wait site.  BaseException with an empty message, so the retry
+    machinery can neither catch it (``retry_transient`` retries only
+    ``Exception``) nor marker-match the inner error as transient — the
+    pull of a stateful iterator must never be re-invoked after it raised.
+    Unwrapped immediately at the call site."""
+
+    def __init__(self, exc: BaseException):
+        super().__init__()
+        self.exc = exc
+
+
+class _StageFailure(RuntimeError):
+    """The H2D staging stage failed for ``item`` (stage thread side):
+    items staged before it are buffered/launched, items after it never
+    left the source."""
+
+    def __init__(self, item, cause: BaseException):
+        super().__init__(str(cause))
+        self.item = item
+        self.cause = cause
+
+
+class _LaunchFailure(RuntimeError):
+    """``launch`` failed for ``item`` (main thread side): the item came
+    off the staged queue BEFORE anything still buffered there."""
+
+    def __init__(self, item, cause: BaseException):
+        super().__init__(str(cause))
+        self.item = item
+        self.cause = cause
+
+
+class _DrainFailure(RuntimeError):
+    """``drain`` failed; the chunk being drained is still accounted in
+    the in-flight deque (popped only on success)."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+def _merge_intervals(ivs: list) -> list:
+    out: list = []
+    for a, b in sorted(ivs):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def overlap_fraction(h2d: list, compute: list) -> float:
+    """Fraction of total H2D staging wall time spent while chunk compute
+    was in flight — the per-run gauge that proves (or disproves) the
+    double-buffering.  0.0 with no staging time."""
+    total = sum(b - a for a, b in h2d)
+    if total <= 0:
+        return 0.0
+    merged = _merge_intervals(compute)
+    ov = 0.0
+    j = 0
+    for a, b in sorted(h2d):
+        while j < len(merged) and merged[j][1] <= a:
+            j += 1
+        k = j
+        while k < len(merged) and merged[k][0] < b:
+            lo, hi = max(a, merged[k][0]), min(b, merged[k][1])
+            if lo < hi:
+                ov += hi - lo
+            k += 1
+    return min(max(ov / total, 0.0), 1.0)
 
 
 def chunked_ingest(
@@ -110,50 +438,287 @@ def chunked_ingest(
     checkpoint_due: Callable[[], bool] | None = None,
     save_checkpoint: Callable[[], None] | None = None,
     prefetch_source: bool = True,
+    stage: Callable | None = None,
+    pipeline_depth: int = 0,
+    ingest: IngestConfig | None = None,
+    recover: Callable | None = None,
+    retain_until_commit: bool = False,
+    metrics: MetricsRecorder | None = None,
 ) -> None:
-    """Drive a bounded source through a launch/drain pipeline with commit
-    points — the host half of the streaming ingest, shared wiring for the
-    resilience/checkpoint discipline:
+    """Drive a bounded source through the staged launch/drain pipeline
+    with commit points — the host half of the streaming ingest, shared
+    wiring for the resilience/checkpoint discipline:
 
-    - ``launch(item)`` dispatches one chunk (async) and returns an
-      in-flight record; up to ``depth`` launches stay in flight before
-      the oldest is drained (``depth == 0`` is fully serial).
+    - ``stage(item)`` (optional) runs the H2D staging stage: pad + issue
+      ``jax.device_put`` (through :func:`staged_put`) and return a staged
+      record.  With ``pipeline_depth > 0`` it runs on a transfer thread
+      holding at most that many staged chunks of device memory (the
+      double buffer); with 0 it runs inline.  Omitted, items flow to
+      ``launch`` unstaged (legacy callers).
+    - ``launch(staged)`` dispatches one chunk (async) against pre-staged
+      device buffers and returns an in-flight record; up to ``depth``
+      launches stay in flight before the oldest is drained (``depth ==
+      0`` is fully serial).
     - ``drain(record)`` completes one launch (the guarded host pull —
       sites/spans belong to the caller's closure).
     - ``commit()`` pulls carry state the kernel accumulates on device
-      (e.g. the donated DF carry).  Called only when NOTHING is in
-      flight — a snapshot must never hold contributions from chunks it
-      does not record as ingested — and once at the end.
-    - ``checkpoint_due()`` / ``save_checkpoint()``: when due, the
-      pipeline drains everything in flight, commits, then snapshots.
+      (e.g. the donated DF carry).  Runs behind the drain-before-commit
+      barrier (:func:`fixpoint.commit_barrier`) — a snapshot must never
+      hold contributions from chunks it does not record as ingested —
+      and once at the end.
+    - ``checkpoint_due()`` / ``save_checkpoint()``: when due, the barrier
+      drains everything in flight, commits, then snapshots.
+    - ``recover(exc, remaining, where)`` (optional): the single recovery
+      point for persistent faults anywhere in the pipeline.  By the time
+      it runs the stage/tokenize threads are torn down and ``remaining``
+      iterates every unprocessed item in stream order (staged, launched
+      and buffered items are re-delivered from their retained host-side
+      form — zero loss, zero double-commits).  ``where`` names the stage
+      that failed (``"stage"`` / ``"wait"`` / ``"launch"`` / ``"drain"``).
+      The hook re-raises faults it does not own, or acknowledges the loss
+      (mesh shrink / CPU salvage), rebuilds device state, and returns the
+      iterable to continue with (usually ``remaining``, possibly
+      regrouped).  Without a hook, the fault propagates as-is.
+    - ``retain_until_commit=True`` additionally retains every DRAINED
+      item until the next commit barrier and re-delivers those too (ahead
+      of everything else) on recovery.  For callers whose drain is not a
+      full commit — single-chip streaming TF-IDF: a drained chunk's TF
+      counts are on host but its DF contribution lives only in the
+      donated device carry, which dies with the device — the recover
+      hook must then roll its own state back to the last commit point so
+      the replay cannot double-count.  Callers whose drain commits
+      everything to host (the sharded path pulls its psum'd DF per
+      super-chunk) leave this False: drained items are done.
 
-    With ``prefetch_source=True`` and ``depth > 0`` the source iterator
-    additionally runs on a background thread (:func:`prefetched`), so
-    host-side chunk preparation overlaps device compute.
+    ``ingest=IngestConfig(...)`` sets ``depth`` (= ``prefetch``) and
+    ``pipeline_depth`` in one bundle.  Per-stage obs spans
+    (``ingest.tokenize`` / ``ingest.h2d`` / ``ingest.compute``), the
+    ``ingest_overlap`` event and the ``h2d_overlap_frac`` gauge are
+    published here so every caller gets the same accounting.
     """
-    depth = max(int(depth), 0)
-    it: Iterable = source
-    if prefetch_source and depth > 0:
-        it = prefetched(iter(source), depth)
+    from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import fixpoint
 
-    inflight: collections.deque = collections.deque()
+    if ingest is not None:
+        depth = ingest.prefetch
+        pipeline_depth = ingest.pipeline_depth
+    depth = max(int(depth), 0)
+    pipeline_depth = max(int(pipeline_depth), 0)
+
+    tok_iv: list = []
+    h2d_iv: list = []
+    comp_iv: list = []
+    inflight: collections.deque = collections.deque()  # (item, record, t0)
+    drained: list = []  # items drained since the last commit barrier
+    # (retained only under retain_until_commit, replayed on recovery)
+
+    def spanned_source(it: Iterator) -> Iterator:
+        # runs on whichever thread consumes it: the tokenize prefetch
+        # thread when prefetch > 0, else the H2D/main thread
+        while True:
+            t0 = time.perf_counter()
+            with obs.span("ingest.tokenize"):
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+            tok_iv.append((t0, time.perf_counter()))
+            yield item
+
+    def stage_wrap(item):
+        t0 = time.perf_counter()
+        try:
+            with obs.span("ingest.h2d"):
+                staged = stage(item)
+        except BaseException as exc:
+            raise _StageFailure(item, exc) from exc
+        h2d_iv.append((t0, time.perf_counter()))
+        return (item, staged)
+
+    def drain_oldest() -> None:
+        item, rec, t0 = inflight[0]
+        try:
+            with obs.span("ingest.compute"):
+                drain(rec)
+        except BaseException as exc:
+            raise _DrainFailure(exc) from exc
+        inflight.popleft()  # popped only on success: a failed drain's
+        # chunk stays accounted as unprocessed for recovery
+        if retain_until_commit:
+            drained.append(item)
+        comp_iv.append((t0, time.perf_counter()))
+
+    def drain_all() -> None:
+        while inflight:
+            drain_oldest()
+
+    def commit_and_release() -> None:
+        # the barrier guarantees nothing is in flight here: once the
+        # carry pull lands, the drained chunks are durably committed and
+        # their retained host copies can go
+        commit()
+        drained.clear()
 
     def maybe_checkpoint() -> None:
         if checkpoint_due is None or save_checkpoint is None:
             return
         if not checkpoint_due():
             return
-        while inflight:  # drain to the commit point
-            drain(inflight.popleft())
-        commit()
-        save_checkpoint()
+        fixpoint.commit_barrier(drain_all, commit_and_release,
+                                save_checkpoint)
 
-    for item in it:
-        inflight.append(launch(item))
-        while len(inflight) > depth:
-            drain(inflight.popleft())
-        maybe_checkpoint()
-    while inflight:
-        drain(inflight.popleft())
-        maybe_checkpoint()
-    commit()
+    # The wait site runs WITHOUT the sync watchdog: its pull is a local
+    # thread handoff (queue read / inline stage), not a device sync —
+    # every device-facing block that feeds it is already deadlined at the
+    # put site on the thread that runs it.  A watchdog here would abandon
+    # an attempt still blocked inside next() on the stateful staged
+    # iterator and retry concurrently; whatever item the abandoned thread
+    # then consumed would vanish from the committed output.
+    wait_policy = dataclasses.replace(rx.RetryPolicy.from_env(),
+                                      deadline_s=0.0)
+    items: Iterator = iter(source)
+    recoveries = 0
+    while True:
+        tok_pf: Prefetched | None = None
+        stage_pf: Prefetched | None = None
+        try:
+            feed: Iterator = spanned_source(items)
+            if prefetch_source and depth > 0:
+                tok_pf = Prefetched(feed, depth)
+                feed = tok_pf
+            if stage is not None:
+                staged_feed: Iterator = map(stage_wrap, feed)
+                if pipeline_depth > 0:
+                    stage_pf = Prefetched(staged_feed, pipeline_depth,
+                                          name="ingest-h2d")
+                    staged_feed = stage_pf
+
+                def next_staged(sf=staged_feed):
+                    # the consumer-side handoff from the staging stage:
+                    # its own chaos/retry site, so faults on in-flight
+                    # staged chunks are injectable from the waiting side.
+                    # The chaos hook fires BEFORE the pull, so a retried
+                    # transient injected fault never consumed an item —
+                    # but an exception coming OUT of the iterator must
+                    # propagate raw even when its message carries a
+                    # transient marker: the iterator is stateful, and
+                    # re-invoking next() would skip the failed item (or
+                    # read _END off a finished Prefetched), silently
+                    # dropping chunks from the committed output.
+                    def pull():
+                        try:
+                            return next(sf, _END)
+                        except BaseException as exc:
+                            raise _IteratorRaised(exc)
+                    try:
+                        return rx.retry_transient(
+                            pull, site=H2D_WAIT_SITE, metrics=metrics,
+                            policy=wait_policy,
+                        )
+                    except _IteratorRaised as carrier:
+                        raise carrier.exc
+            else:
+                plain = map(lambda it_: (it_, it_), feed)
+
+                def next_staged(sf=plain):
+                    return next(sf, _END)
+
+            while True:
+                env = next_staged()
+                if env is _END:
+                    break
+                item, staged = env
+                t0 = time.perf_counter()
+                try:
+                    rec = launch(staged)
+                except BaseException as exc:
+                    raise _LaunchFailure(item, exc) from exc
+                inflight.append((item, rec, t0))
+                while len(inflight) > depth:
+                    drain_oldest()
+                maybe_checkpoint()
+            while inflight:
+                drain_oldest()
+                maybe_checkpoint()
+            fixpoint.commit_barrier(drain_all, commit_and_release)
+            break
+        except BaseException as exc:  # noqa: BLE001 — dispatched below
+            cause: BaseException = exc
+            where = "drain"
+            failed_early: list = []  # failed item ordered before buffers
+            failed_late: list = []  # failed item ordered after buffers
+            if isinstance(exc, _DrainFailure):
+                cause, where = exc.cause, "drain"
+            elif isinstance(exc, _LaunchFailure):
+                cause, where = exc.cause, "launch"
+                failed_early = [exc.item]
+            elif isinstance(exc, _StageFailure):
+                cause, where = exc.cause, "stage"
+                failed_late = [exc.item]
+            elif inflight or stage is not None:
+                where = "drain" if inflight else "wait"
+            # Tear the pipeline down FIRST: recovery must never race the
+            # stage thread (a put onto a dying mesh) — and collect every
+            # unprocessed item in stream order: drained-but-uncommitted
+            # (when retained), launched-but-undrained, the launch
+            # casualty, staged-but-unlaunched buffers, the stage
+            # casualty, then unstaged tokenized buffers.
+            replay = list(drained)
+            drained.clear()
+            pending = [it for (it, _rec, _t0) in inflight]
+            inflight.clear()
+            staged_left: list = []
+            src_raised: list = []  # swept-up SOURCE/tokenize exceptions:
+            # the stream is truncated past them, so replay must re-raise
+            # them in stream position, never complete "successfully"
+            if stage_pf is not None:
+                stage_pf.close()
+                staged_left = [it for (it, _st) in stage_pf.leftover()]
+                # a stage failure the consumer never read (it died first,
+                # e.g. at the wait site): the casualty item rides in the
+                # swept-up exception — salvage it, in queue order (the
+                # producer stops at its first failure, so it is last).
+                # Anything else swept here propagated through the stage
+                # thread FROM the source (stage_wrap wraps stage faults).
+                for r_exc in stage_pf.raised():
+                    if isinstance(r_exc, _StageFailure):
+                        staged_left.append(r_exc.item)
+                    else:
+                        src_raised.append(r_exc)
+            tok_left: list = []
+            if tok_pf is not None:
+                tok_pf.close()
+                tok_left = tok_pf.leftover()
+                src_raised.extend(tok_pf.raised())
+            if recover is None:
+                raise cause
+            recoveries += 1
+            if recoveries > _MAX_RECOVERIES:
+                raise cause
+            head = (replay + pending + failed_early + staged_left
+                    + failed_late + tok_left)
+
+            def chained(head=head, tail=items, swept=src_raised):
+                yield from head
+                if swept:
+                    # the source raised before teardown and the consumer
+                    # never saw it: past this point the stream does not
+                    # exist, so it must fail here, not end
+                    raise swept[0].with_traceback(swept[0].__traceback__)
+                yield from tail
+
+            items = iter(recover(cause, chained(), where))
+
+    frac = overlap_fraction(h2d_iv, comp_iv)
+    summary = {
+        "h2d_overlap_frac": round(frac, 4),
+        "tokenize_secs": round(sum(b - a for a, b in tok_iv), 4),
+        "h2d_secs": round(sum(b - a for a, b in h2d_iv), 4),
+        "compute_secs": round(sum(b - a for a, b in comp_iv), 4),
+        "chunks": len(comp_iv),
+        "depth": depth,
+        "pipeline_depth": pipeline_depth,
+    }
+    obs.gauge("h2d_overlap_frac", frac)
+    obs.emit("ingest_overlap", **summary)
+    if metrics is not None:
+        metrics.record(event="ingest_overlap", **summary)

@@ -28,6 +28,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import ingest as dflow
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, TfMode, ensure_dtype_support
@@ -366,10 +367,12 @@ def _tokenized_chunks(
         yield i, corpus
 
 
-# The background-thread source buffer is dataflow machinery now
-# (dataflow/ingest.py); the sharded ingest path still imports it under
-# this name.
-_prefetched = dflow.prefetched
+# Commit-barrier interval (in chunks) for streaming runs WITHOUT
+# checkpointing: bounds how many drained chunks' host copies
+# retain_until_commit may hold (the elastic rung replays at most this
+# span after a device loss).  With 2^18-token chunks this caps retention
+# near 16M tokens of int32 pairs — flat host memory, rare drain bubbles.
+_RETAIN_COMMIT_EVERY = 16
 
 
 def run_tfidf_streaming(
@@ -387,24 +390,36 @@ def run_tfidf_streaming(
     a power of two) so the device kernel compiles once; an oversized chunk
     bumps the capacity with a logged recompile (SURVEY.md §7).
 
-    The loop is a three-stage software pipeline (SURVEY.md §5.7): a
-    background thread tokenizes up to ``cfg.prefetch`` chunks ahead; the
-    main thread launches the device kernel and defers the host pull of each
-    chunk's results until ``cfg.prefetch`` launches are in flight, so
-    tokenize / device compute / device→host copy of adjacent chunks
-    overlap.  ``prefetch=0`` is fully serial: no background thread (the
-    caller's iterator runs on the calling thread) and every chunk syncs
-    before the next launches.  Results are bit-identical at every depth —
-    only scheduling changes.
+    The loop is a four-stage software pipeline (SURVEY.md §5.7, ISSUE 10):
+    a background thread tokenizes up to ``cfg.prefetch`` chunks ahead; a
+    **transfer thread** pads each chunk and issues its ``jax.device_put``
+    (the H2D staging stage, chaos/retry site ``ingest_h2d_put``) holding
+    at most ``cfg.pipeline_depth`` staged chunks of device memory — chunk
+    N+1's transfer runs under chunk N's compute; the main thread
+    dispatches the once-compiled kernel against pre-staged device buffers
+    only and defers each chunk's host pull until ``cfg.prefetch`` launches
+    are in flight.  ``prefetch=0, pipeline_depth=0`` is fully serial: no
+    background threads and every chunk syncs before the next launches.
+    ``cfg.pack_target_tokens > 0`` additionally re-packs the incoming
+    chunking to fill the compiled capacity (padding, not scheduling, is
+    most of the measured streaming-vs-batch gap).  Results are
+    bit-identical at every depth — only scheduling changes.
 
     The DF accumulator is an **ingest carry**: a device-resident vector
     threaded through :func:`ops.tfidf.chunk_counts_carry` with its buffer
     donated, so XLA updates it in place every chunk and the host never
     pulls DF per chunk.  DF reaches the host only at *commit points* —
-    checkpoint saves and finalize — which also means a checkpoint can only
-    be written once every in-flight launch has drained (a snapshot must
-    never contain DF contributions from chunks it does not record as
-    ingested).
+    checkpoint saves and finalize — behind the drain-before-commit
+    barrier (``dataflow.fixpoint.commit_barrier``): a snapshot can only be
+    written once every in-flight launch has drained, so it never contains
+    DF contributions from chunks it does not record as ingested.
+
+    Device loss anywhere in the pipeline (an H2D put on the transfer
+    thread included — chaos site ``ingest_h2d_put``) walks the single-chip
+    elastic rung: the loss is acknowledged, host state rolls back to the
+    last commit point, and the pipeline replays the uncommitted span from
+    the host copies it retained — the tokenized chunks — onto the CPU
+    backend, byte-identically.  Committed chunks are never reprocessed.
     """
     ensure_dtype_support(cfg.dtype)
     metrics = metrics or MetricsRecorder()
@@ -420,22 +435,60 @@ def run_tfidf_streaming(
     # The device-resident DF carry (donated to every chunk dispatch; this
     # reference is always the LATEST carry, never a consumed one).
     df_dev = jnp.asarray(st.df_total)
+    # None until a device loss: the elastic rung then pins every
+    # subsequent put (and so every dispatch) to the CPU backend.
+    target_dev = None
 
-    depth = max(int(cfg.prefetch), 0)
+    if cfg.pack_target_tokens > 0:
+        doc_chunks = dflow.pack_doc_chunks(
+            doc_chunks, cfg.pack_target_tokens,
+            estimate=dflow.ngram_estimator(cfg.ngram))
     source = _tokenized_chunks(doc_chunks, cfg, st.chunk_index, st.n_docs)
 
-    def launch(item):
-        """Pad one tokenized chunk to the fixed capacity and dispatch the
-        once-compiled kernel (async); the in-flight record carries what
-        the drain needs to commit it."""
-        nonlocal cap, df_dev
+    # Rollback point for the elastic rung: what st looked like at the
+    # last commit barrier.  Chunks drained after it have host TF parts
+    # but their DF lives only in the (now dead) device carry — recovery
+    # truncates them here and the pipeline replays their retained host
+    # copies, so nothing is lost and nothing double-counts.
+    committed: dict = {}
+
+    def snap_commit() -> None:
+        committed.update(
+            parts=len(st.parts), dls=len(st.doc_length_parts),
+            n_docs=st.n_docs, n_tokens=st.n_tokens, chunk=st.chunk_index,
+        )
+
+    snap_commit()
+
+    def _put(arr):
+        return (jax.device_put(arr, target_dev) if target_dev is not None
+                else jax.device_put(arr))
+
+    def stage_chunk(item):
+        """H2D staging stage (transfer thread when pipeline_depth > 0):
+        pad one tokenized chunk to the fixed capacity and issue its
+        device transfers through the guarded staging site.  The item's
+        host arrays stay retained by the pipeline until commit — the
+        elastic rung re-stages from them."""
+        nonlocal cap
         i, corpus = item
         cap, _ = grow_chunk_cap(corpus.n_tokens, cap, metrics, chunk=i)
         doc_ids, term_ids, valid = _pad_chunk(corpus, cap)
+        d_doc, d_term, d_valid = dflow.staged_put(
+            lambda: (_put(doc_ids), _put(term_ids), _put(valid)),
+            metrics=metrics,
+        )
+        return (i, corpus, d_doc, d_term, d_valid)
+
+    def launch(staged):
+        """Dispatch the once-compiled kernel (async) against pre-staged
+        device buffers only; the in-flight record carries what the drain
+        needs to commit it."""
+        nonlocal df_dev
+        i, corpus, d_doc, d_term, d_valid = staged
         with Timer() as t:
             counts, df_dev = ops.chunk_counts_carry(
-                jnp.asarray(doc_ids), jnp.asarray(term_ids),
-                jnp.asarray(valid), df_dev, vocab=vocab,
+                d_doc, d_term, d_valid, df_dev, vocab=vocab,
             )  # async dispatch — no block here; df carry updated in place
         return (i, counts, corpus.doc_lengths,
                 corpus.n_docs, corpus.n_tokens, t)
@@ -487,32 +540,83 @@ def run_tfidf_streaming(
                 df_dev, site="tfidf_df_commit", metrics=metrics,
                 checkpoint_dir=cfg.checkpoint_dir,
             ).astype(dtype)
+        snap_commit()
+
+    def recover(exc, remaining, where):
+        """Single-chip elastic rung for the staged pipeline: a
+        device-attributed loss anywhere in it (H2D put on the transfer
+        thread, dispatch, drain) is acknowledged, host state rolls back
+        to the last commit point, the DF carry is rebuilt from committed
+        host DF on the CPU backend, and the pipeline replays the
+        uncommitted span from its retained host chunks (byte-identical
+        order).  Anything else — elastic disabled, whole-backend faults
+        with no device index — re-raises into the pre-existing ladder
+        (ResilienceExhausted + checkpoint)."""
+        nonlocal df_dev, target_dev
+        lost = elastic.unwrap_device_loss(exc)
+        idx = elastic.device_index(lost) if lost is not None else None
+        if not elastic.enabled() or idx is None:
+            raise exc
+        elastic.health().mark_lost(idx)
+        site = {"stage": dflow.H2D_PUT_SITE,
+                "wait": dflow.H2D_WAIT_SITE}.get(where, "tfidf_chunk_sync")
+        rerun = st.chunk_index - committed["chunk"]
+        obs.emit("degraded", site=site, ladder="cpu",
+                 salvage_chunk=committed["chunk"], rerun_chunks=rerun,
+                 error=f"{type(exc).__name__}: {exc}"[:200])
+        obs.counter("degraded")
+        metrics.record(event="degraded", site=site, ladder="cpu",
+                       salvage_chunk=committed["chunk"], rerun_chunks=rerun)
+        with obs.span("tfidf.cpu_salvage", at_chunk=committed["chunk"],
+                      rerun_chunks=rerun):
+            del st.parts[committed["parts"]:]
+            del st.doc_length_parts[committed["dls"]:]
+            st.n_docs = committed["n_docs"]
+            st.n_tokens = committed["n_tokens"]
+            st.chunk_index = committed["chunk"]
+            target_dev = jax.devices("cpu")[0]
+            df_dev = jax.device_put(st.df_total, target_dev)
+        return remaining
 
     def checkpoint_due() -> bool:
-        if not (cfg.checkpoint_every > 0 and cfg.checkpoint_dir):
-            return False
-        return st.chunk_index - last_ckpt >= cfg.checkpoint_every
+        if cfg.checkpoint_every > 0 and cfg.checkpoint_dir:
+            return st.chunk_index - last_ckpt >= cfg.checkpoint_every
+        # Checkpointing off: retain_until_commit would otherwise hold
+        # every drained chunk's host copy until the single end-of-stream
+        # commit — a second full-corpus copy.  A commit-only barrier (DF
+        # pull + rollback-point re-snap, no snapshot file) every K chunks
+        # keeps host memory flat at the cost of one pipeline drain per K.
+        return st.chunk_index - last_ckpt >= _RETAIN_COMMIT_EVERY
 
     def save_ckpt():
         nonlocal last_ckpt
+        last_ckpt = st.chunk_index
+        if not (cfg.checkpoint_every > 0 and cfg.checkpoint_dir):
+            return  # retention-bounding barrier: commit already ran
         st.ingest_secs = secs0 + (time.perf_counter() - run_started)
         save_ingest_checkpoint(cfg, metrics, st)
-        last_ckpt = st.chunk_index
+        # the save compacts st.parts in place — re-snap the rollback
+        # point so its list indices match the compacted layout
+        snap_commit()
 
-    # The host pipeline — bounded in-flight launches, drain-to-commit
-    # checkpoints, background source prefetch — is the dataflow core's
-    # chunked_ingest primitive; this driver only supplies the TF-IDF
-    # closures (and keeps its guarded sites/spans byte-identical to the
-    # pre-port path).
+    # The host pipeline — staged H2D double-buffering, bounded in-flight
+    # launches, drain-before-commit checkpoints, background source
+    # prefetch, elastic recovery — is the dataflow core's chunked_ingest
+    # primitive; this driver only supplies the TF-IDF closures (and keeps
+    # its guarded sites/spans byte-identical to the pre-port path).
     with obs.span("tfidf.stream", resume_chunk=st.chunk_index):
         dflow.chunked_ingest(
             source,
+            stage=stage_chunk,
             launch=launch,
             drain=drain_one,
             commit=commit_df,
-            depth=depth,
+            ingest=cfg.ingest(),
             checkpoint_due=checkpoint_due,
             save_checkpoint=save_ckpt,
+            recover=recover,
+            retain_until_commit=True,
+            metrics=metrics,
         )
 
     return finalize_tfidf(st, cfg, metrics)

@@ -13,12 +13,26 @@ torrent protocol.
 
 Shapes: a "super-chunk" is [D, cap] token arrays, one row per device;
 compile happens once per (D, cap).
+
+Since ISSUE 10 the host loop IS ``dataflow.ingest.chunked_ingest`` — the
+same staged pipeline as single-chip streaming: a tokenize thread feeds
+super-chunk groups, a transfer thread issues the **sharded puts** for
+group N+1 (chaos/retry site ``ingest_h2d_put``) while group N computes,
+holding at most ``cfg.pipeline_depth`` staged groups of device memory,
+and the drain is the one guarded batched pull per super-chunk.  Device
+loss anywhere in the pipeline reaches the single recovery point: the
+committed ingest state is checkpointed, the mesh is rebuilt over the
+survivors (``elastic.plan_shrink``), and the pipeline **re-slices the
+in-flight staged groups over the shrunk mesh** by regrouping the host
+corpora it retained — committed chunks are never reprocessed, and a
+second loss inside the replay simply re-enters the same recovery point
+(4 → 2 → 1 chaos-tested).
 """
 
 from __future__ import annotations
 
 import time
-from typing import Iterable, Sequence
+from typing import Iterable, Iterator, Sequence
 
 import jax
 import numpy as np
@@ -26,11 +40,11 @@ from page_rank_and_tfidf_using_apache_spark_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import ingest as dflow
 from page_rank_and_tfidf_using_apache_spark_tpu.io import text as tio
 from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
     IngestState,
     TfidfOutput,
-    _prefetched,
     _tokenized_chunks,
     finalize_tfidf,
     grow_chunk_cap,
@@ -40,6 +54,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel import collectives as coll
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
     DATA_AXIS,
@@ -47,7 +62,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
     rebuild_mesh,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig, ensure_dtype_support
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
 
 
 def _publish_device_timings(arr, step: int) -> None:
@@ -128,7 +143,7 @@ def run_tfidf_sharded(
     dtype = cfg.dtype
 
     cap = cfg.chunk_tokens
-    kernel = None
+    kernel = make_sharded_counts_kernel(mesh, vocab)
     esh = NamedSharding(mesh, P(axis, None))
 
     st = (resume_ingest(cfg, metrics) if resume
@@ -136,38 +151,38 @@ def run_tfidf_sharded(
     last_ckpt = st.chunk_index
     secs0 = st.ingest_secs
     run_started = time.perf_counter()
-
-    # Tokenize on a background thread, up to cfg.prefetch chunks ahead
-    # (SURVEY.md §5.7 — same double-buffering as the single-chip streaming
-    # path; cfg.prefetch=0 keeps everything on the calling thread).  The
-    # consumer pulls d chunks per super-chunk incrementally, so the buffer
-    # bound stays exactly what the user asked for.
-    source = _tokenized_chunks(doc_chunks, cfg, st.chunk_index, st.n_docs)
-    if cfg.prefetch > 0:
-        source = _prefetched(source, int(cfg.prefetch))
-    chunk_iter = iter(source)
     step = 0
-    while True:
-        group: list[tio.TokenizedCorpus] = []
-        for _ in range(d):
-            item = next(chunk_iter, None)
-            if item is None:
-                break
-            _, corpus = item
-            group.append(corpus)
-        if not group:
-            break
-        need = max(c.n_tokens for c in group)
-        cap, changed = grow_chunk_cap(need, cap, metrics)
-        if changed:
-            kernel = None
-        if kernel is None:
-            kernel = make_sharded_counts_kernel(mesh, vocab)
 
-        # st is NOT touched until the pull commits below: the elastic rung
-        # may checkpoint st mid-group, and a snapshot must only ever hold
-        # fully-committed chunks (n_docs for an uncommitted group would
-        # poison the resume-side chunking validation).
+    if cfg.pack_target_tokens > 0:
+        doc_chunks = dflow.pack_doc_chunks(
+            doc_chunks, cfg.pack_target_tokens,
+            estimate=dflow.ngram_estimator(cfg.ngram))
+    chunk_source = _tokenized_chunks(doc_chunks, cfg, st.chunk_index,
+                                     st.n_docs)
+
+    def grouped(src: Iterator) -> Iterator[list[tio.TokenizedCorpus]]:
+        # one pipeline item = one super-chunk group of <= d corpora; ``d``
+        # is read per group, so after a shrink the tail arrives pre-sized
+        # (in-flight old-width groups are regrouped by ``recover`` below)
+        buf: list[tio.TokenizedCorpus] = []
+        for _, corpus in src:
+            buf.append(corpus)
+            if len(buf) >= d:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
+    def stage_group(group: list[tio.TokenizedCorpus]):
+        """H2D staging stage (transfer thread): build the [D, cap] host
+        arrays for one super-chunk and issue the sharded puts through the
+        guarded staging site.  The group's corpora stay retained by the
+        pipeline until the drain commits them, so the recovery point can
+        re-slice them over a rebuilt mesh.  The staged record carries the
+        group along — the drain commits per input chunk."""
+        nonlocal cap
+        need = max(c.n_tokens for c in group)
+        cap, _ = grow_chunk_cap(need, cap, metrics)
         doc_ids = np.zeros((d, cap), np.int32)
         term_ids = np.zeros((d, cap), np.int32)
         valid = np.zeros((d, cap), bool)
@@ -175,149 +190,151 @@ def run_tfidf_sharded(
             doc_ids[i, : c.n_tokens] = c.doc_ids
             term_ids[i, : c.n_tokens] = c.term_ids
             valid[i, : c.n_tokens] = True
+        dev = dflow.staged_put(
+            lambda: (jax.device_put(doc_ids, esh),
+                     jax.device_put(term_ids, esh),
+                     jax.device_put(valid, esh)),
+            metrics=metrics,
+        )
+        return (group, dev)
 
-        def elastic_reslice(exc, doc_ids=doc_ids, term_ids=term_ids,
-                            valid=valid):
-            """Mesh-shrink rung: on device loss, checkpoint the committed
-            ingest state, rebuild the mesh/kernel over the survivors, and
-            re-slice the in-flight super-chunk (never-committed work) into
-            new-width dispatches.  Committed chunks are untouched — zero
-            reprocessing, same guarantee as the resume path."""
-            nonlocal mesh, d, esh, kernel, last_ckpt
-            if not elastic.enabled() or not elastic.is_device_loss(exc):
-                raise exc
-            idx = elastic.device_index(exc)
-            if idx is not None:
-                elastic.health().mark_lost(idx)
-            if cfg.checkpoint_dir and st.parts:
-                st.ingest_secs = secs0 + (time.perf_counter() - run_started)
-                save_ingest_checkpoint(cfg, metrics, st,
-                                       extra_meta={"devices": d})
-                last_ckpt = st.chunk_index
-            plan = elastic.plan_shrink(list(mesh.devices.flat))
-            if plan is None:
-                raise exc
-            with elastic.publish_shrink("tfidf_shard_sync", plan, exc,
-                                        metrics):
-                # keep the dying mesh's axis name: a caller-provided mesh
-                # may not be named DATA_AXIS, and esh below is built from
-                # the same ``axis``
-                mesh = rebuild_mesh(plan.devices, axis)
-                d = plan.new_count
-                esh = NamedSharding(mesh, P(axis, None))
-                kernel = make_sharded_counts_kernel(mesh, vocab)
-            rows = doc_ids.shape[0]
-            outs: list[tuple] = []
-            df_sum = None
-            with obs.span("tfidf.reslice", rows=rows, width=d):
-                lo = 0
-                while lo < rows:
-                    batch = slice(lo, lo + d)
-                    b_doc = np.zeros((d, cap), np.int32)
-                    b_term = np.zeros((d, cap), np.int32)
-                    b_valid = np.zeros((d, cap), bool)
-                    n_rows = doc_ids[batch].shape[0]
-                    b_doc[:n_rows] = doc_ids[batch]
-                    b_term[:n_rows] = term_ids[batch]
-                    b_valid[:n_rows] = valid[batch]
-                    try:
-                        (r_doc, r_term, r_cnt, r_np, _rv), r_df = kernel(
-                            jax.device_put(b_doc, esh),
-                            jax.device_put(b_term, esh),
-                            jax.device_put(b_valid, esh),
-                        )
-                        # one batched pull per re-sliced dispatch: the
-                        # shrunk mesh processes the in-flight rows
-                        # sequentially, so each sub-dispatch syncs before
-                        # the next launches
-                        h = rx.device_get(  # graftlint: disable=host-sync-in-loop (one batched pull per re-sliced dispatch on the rare shrink path)
-                            (r_doc, r_term, r_cnt, r_np, r_df),
-                            site="tfidf_shard_sync", metrics=metrics,
-                            checkpoint_dir=cfg.checkpoint_dir,
-                        )
-                    except Exception as exc2:  # noqa: BLE001 — re-caught below
-                        # A SECOND device dying inside the shrink-rerun
-                        # (ISSUE 8 elastic gap): re-enter the ladder —
-                        # mark the new loss, plan the next shrink from the
-                        # CURRENT (already-shrunk) mesh, rebuild the
-                        # kernel, and re-dispatch the same rows at the new
-                        # width.  Committed rows (< lo) stay committed.
-                        lost = elastic.unwrap_device_loss(exc2)
-                        if lost is None or not elastic.enabled():
-                            raise
-                        idx2 = elastic.device_index(lost)
-                        if idx2 is not None:
-                            elastic.health().mark_lost(idx2)
-                        plan2 = elastic.plan_shrink(list(mesh.devices.flat))
-                        if plan2 is None:
-                            raise
-                        with elastic.publish_shrink(
-                            "tfidf_shard_sync", plan2, lost, metrics
-                        ):
-                            mesh = rebuild_mesh(plan2.devices, axis)
-                            d = plan2.new_count
-                            esh = NamedSharding(mesh, P(axis, None))
-                            kernel = make_sharded_counts_kernel(mesh, vocab)
-                        continue  # same lo: nothing from this batch committed
-                    outs.append(h[:4])
-                    df_sum = h[4] if df_sum is None else df_sum + h[4]
-                    lo += n_rows
-            return (
-                np.concatenate([o[0] for o in outs]),
-                np.concatenate([o[1] for o in outs]),
-                np.concatenate([o[2] for o in outs]),
-                np.concatenate([np.atleast_1d(o[3]).ravel() for o in outs]),
-                df_sum,
-            )
+    def launch_group(staged):
+        nonlocal step
+        group, (d_doc, d_term, d_valid) = staged
+        t0 = time.perf_counter()
+        (c_doc, c_term, c_cnt, c_np, _c_valid), df = kernel(
+            d_doc, d_term, d_valid
+        )  # async dispatch — the pull waits in the drain
+        rec = (group, step, c_doc, c_term, c_cnt, c_np, df, t0)
+        step += 1
+        return rec
 
-        with Timer() as t, obs.span("tfidf.super_chunk", step=step,
-                                    chunk=st.chunk_index):
-            (c_doc, c_term, c_cnt, c_np, _c_valid), df = kernel(
-                jax.device_put(doc_ids, esh),
-                jax.device_put(term_ids, esh),
-                jax.device_put(valid, esh),
-            )
+    def drain_group(rec) -> None:
+        group, step_i, c_doc, c_term, c_cnt, c_np, df, t0 = rec
+        with obs.span("tfidf.super_chunk", step=step_i,
+                      chunk=st.chunk_index):
             # per-device shard-ready times onto the bus BEFORE the batched
             # pull, so the trace's chunk timeline can attribute a slow
             # super-chunk to the straggling device (hardening (d))
-            _publish_device_timings(c_np, step)
+            _publish_device_timings(c_np, step_i)
             # One batched device->host pull: a single round-trip per
-            # super-chunk instead of a block_until_ready fence plus four
-            # separate np.asarray transfers (each paying tunnel RTT).
-            # Guarded: a transient failure re-issues the pull against the
-            # live buffers; device loss shrinks the mesh (elastic rung);
-            # exhaustion carries the chunk checkpoint.
+            # super-chunk instead of a fence plus four separate transfers
+            # (each paying tunnel RTT).  Guarded: a transient failure
+            # re-issues the pull against the live buffers; persistent
+            # faults walk the ladder and surface to the pipeline's
+            # recovery point (mesh shrink + re-slice of retained groups).
             h_doc, h_term, h_cnt, n_pairs, h_df = rx.device_get(
                 (c_doc, c_term, c_cnt, c_np, df),
                 site="tfidf_shard_sync", metrics=metrics,
                 checkpoint_dir=cfg.checkpoint_dir,
-                fallbacks=[(None, elastic_reslice)],
             )
         st.df_total = st.df_total + h_df.astype(dtype)
-        n_pairs = n_pairs.ravel()
+        n_pairs = np.asarray(n_pairs).ravel()
         for i, c in enumerate(group):
             k = int(n_pairs[i])
             # .copy() so parts holds k-sized arrays, not views pinning the
             # whole (d, cap) transfer buffer until finalize
             st.parts.append(
-                (h_doc[i, :k].copy(), h_term[i, :k].copy(), h_cnt[i, :k].copy())
+                (h_doc[i, :k].copy(), h_term[i, :k].copy(),
+                 h_cnt[i, :k].copy())
             )
             st.doc_length_parts.append(c.doc_lengths)
         st.n_docs += int(sum(c.n_docs for c in group))
         st.chunk_index += len(group)
         st.n_tokens += int(sum(c.n_tokens for c in group))
         metrics.record(
-            event="super_chunk", step=step, devices=len(group), docs=st.n_docs,
-            tokens=int(sum(c.n_tokens for c in group)), secs=t.elapsed,
+            event="super_chunk", step=step_i, devices=len(group),
+            docs=st.n_docs, tokens=int(sum(c.n_tokens for c in group)),
+            secs=time.perf_counter() - t0,
         )
-        step += 1
-        if (
-            cfg.checkpoint_every > 0 and cfg.checkpoint_dir
-            and st.chunk_index - last_ckpt >= cfg.checkpoint_every
-        ):
+
+    def checkpoint_due() -> bool:
+        if not (cfg.checkpoint_every > 0 and cfg.checkpoint_dir):
+            return False
+        return st.chunk_index - last_ckpt >= cfg.checkpoint_every
+
+    def save_ckpt() -> None:
+        nonlocal last_ckpt
+        st.ingest_secs = secs0 + (time.perf_counter() - run_started)
+        save_ingest_checkpoint(cfg, metrics, st, extra_meta={"devices": d})
+        last_ckpt = st.chunk_index
+
+    def regrouped(remaining: Iterator) -> Iterator[list]:
+        # re-slice: flatten whatever group widths the dying mesh left in
+        # flight and regroup to the CURRENT mesh width (``grouped`` reads
+        # ``d`` per group — a second shrink inside the replay re-sizes
+        # again)
+        return grouped((None, c) for group in remaining for c in group)
+
+    def recover(exc, remaining, where):
+        """Mesh-shrink recovery point: on device loss anywhere in the
+        pipeline (H2D put, dispatch, drain), checkpoint the committed
+        ingest state, rebuild the mesh/kernel over the survivors, and
+        re-slice the in-flight staged groups (retained as host corpora by
+        the pipeline) over the shrunk mesh.  Committed chunks are
+        untouched — zero reprocessing, same guarantee as the resume path.
+        A further loss inside the replay re-enters here (the stacked-loss
+        re-entry the elastic ladder requires)."""
+        nonlocal mesh, d, esh, kernel, last_ckpt
+        # Salvage committed work FIRST: whatever happens next (shrink or
+        # re-raise into the legacy ladder), the chunks already committed
+        # must survive as a snapshot.  The old loop had this for free —
+        # its periodic save ran before the next drain could fail; the
+        # pipeline's drain-before-commit barrier can order a failing
+        # drain ahead of a due checkpoint.
+        saved = None
+        if cfg.checkpoint_dir and st.parts:
             st.ingest_secs = secs0 + (time.perf_counter() - run_started)
             save_ingest_checkpoint(cfg, metrics, st,
                                    extra_meta={"devices": d})
             last_ckpt = st.chunk_index
+            saved = ckpt.latest_checkpoint(cfg.checkpoint_dir)
+
+        def reraise():
+            # an exhausted ladder raised before the salvage above existed
+            # must still hand the caller the freshest snapshot
+            if (saved is not None
+                    and isinstance(exc, rx.ResilienceExhausted)
+                    and exc.last_checkpoint is None):
+                raise rx.ResilienceExhausted(
+                    exc.site, exc.attempts, exc.last_error, saved
+                ) from exc
+            raise exc
+
+        lost = elastic.unwrap_device_loss(exc)
+        if not elastic.enabled() or lost is None:
+            reraise()
+        idx = elastic.device_index(lost)
+        if idx is not None:
+            elastic.health().mark_lost(idx)
+        plan = elastic.plan_shrink(list(mesh.devices.flat))
+        if plan is None:
+            reraise()
+        site = {"stage": dflow.H2D_PUT_SITE,
+                "wait": dflow.H2D_WAIT_SITE}.get(where, "tfidf_shard_sync")
+        with elastic.publish_shrink(site, plan, lost, metrics):
+            # keep the dying mesh's axis name: a caller-provided mesh may
+            # not be named DATA_AXIS, and esh below is built from ``axis``
+            mesh = rebuild_mesh(plan.devices, axis)
+            d = plan.new_count
+            esh = NamedSharding(mesh, P(axis, None))
+            kernel = make_sharded_counts_kernel(mesh, vocab)
+        return regrouped(remaining)
+
+    with obs.span("tfidf.shard_stream", devices=d,
+                  resume_chunk=st.chunk_index):
+        dflow.chunked_ingest(
+            grouped(chunk_source),
+            stage=stage_group,
+            launch=launch_group,
+            drain=drain_group,
+            commit=lambda: None,  # the drain's pull IS the commit: DF is
+            # psum'd and pulled per super-chunk, nothing stays on device
+            ingest=cfg.ingest(),
+            checkpoint_due=checkpoint_due,
+            save_checkpoint=save_ckpt,
+            recover=recover,
+            metrics=metrics,
+        )
 
     return finalize_tfidf(st, cfg, metrics)

@@ -197,6 +197,64 @@ def attempt_once(
     return _attempt(fn, site, policy)
 
 
+def _retry_pause(
+    site: str,
+    attempts: int,
+    exc: BaseException,
+    policy: RetryPolicy,
+    metrics: MetricsRecorder | None,
+) -> None:
+    """The shared between-attempts pause of both retry loops: emit the
+    ``retry`` event/counter (and mirror it to the caller's metrics), sleep
+    the backoff, then emit ``backoff``.  The backoff event is emitted
+    AFTER the sleep: it records that the backoff completed (a kill
+    mid-backoff then shows a retry with no backoff event), which is what
+    distinguishes it from the retry event."""
+    delay = backoff_delay(site, attempts, policy)
+    err = f"{type(exc).__name__}: {exc}"[:200]
+    obs.emit("retry", site=site, attempt=attempts, error=err,
+             backoff_s=round(delay, 4))
+    obs.counter("retries")
+    if metrics is not None:
+        metrics.record(event="retry", site=site, attempt=attempts,
+                       error=err, backoff_s=round(delay, 4))
+    time.sleep(delay)
+    obs.emit("backoff", site=site, attempt=attempts, secs=round(delay, 4))
+    obs.histogram("backoff_secs", delay)
+
+
+def retry_transient(
+    fn: Callable[[], Any],
+    *,
+    site: str,
+    policy: RetryPolicy | None = None,
+    metrics: MetricsRecorder | None = None,
+) -> Any:
+    """:func:`run_guarded`'s transient-retry half WITHOUT the terminal
+    rung-walking or ``exhausted`` emission: transient faults retry with the
+    same backoff/telemetry, but persistent faults (device loss) and an
+    expired retry budget propagate RAW to the caller.
+
+    For call sites whose recovery lives at a coarser granularity than one
+    guarded call — the staged ingest pipeline (``dataflow.ingest``): a
+    device loss at an H2D put on the transfer thread is handled by the
+    pipeline's recovery point (tear down, shrink/salvage, re-stage from
+    retained host copies), so an ``exhausted`` event here would misreport
+    a recoverable loss as a dead ladder.  Same precedent as
+    :func:`attempt_once` (the elastic shrink-rerun's re-entry path).
+    ``fn`` must be re-invocable."""
+    policy = policy or RetryPolicy.from_env()
+    attempts = 0
+    while True:
+        attempts += 1
+        try:
+            return _attempt(fn, site, policy)
+        except Exception as exc:
+            if not is_transient(exc) or attempts > policy.max_retries:
+                raise
+            _retry_pause(site, attempts, exc, policy, metrics)
+
+
 def run_guarded(
     fn: Callable[[], Any],
     *,
@@ -240,23 +298,7 @@ def run_guarded(
                 break
             if attempts > policy.max_retries:
                 break
-            delay = backoff_delay(site, attempts, policy)
-            err = f"{type(exc).__name__}: {exc}"[:200]
-            obs.emit("retry", site=site, attempt=attempts, error=err,
-                     backoff_s=round(delay, 4))
-            obs.counter("retries")
-            if metrics is not None:
-                metrics.record(
-                    event="retry", site=site, attempt=attempts,
-                    error=err, backoff_s=round(delay, 4),
-                )
-            time.sleep(delay)
-            # emitted AFTER the sleep: it records that the backoff completed
-            # (a kill mid-backoff then shows a retry with no backoff event),
-            # which is what distinguishes it from the retry event above
-            obs.emit("backoff", site=site, attempt=attempts,
-                     secs=round(delay, 4))
-            obs.histogram("backoff_secs", delay)
+            _retry_pause(site, attempts, exc, policy, metrics)
 
     rungs = list(fallbacks or [])
     if fallback is not None:

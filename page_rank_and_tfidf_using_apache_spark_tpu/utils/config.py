@@ -81,6 +81,38 @@ def ensure_dtype_support(dtype: str) -> None:
             jax.config.update("jax_enable_x64", True)
 
 
+@dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """Knobs of the staged ingest pipeline (``dataflow.ingest.chunked_ingest``):
+    tokenize → H2D staging → compute run as overlapped stages, and these two
+    depths bound how far each stage may run ahead (the backpressure that
+    keeps host and device memory flat).
+
+    - ``prefetch``: how many tokenized chunks the background tokenizer
+      thread may buffer ahead of the H2D stage, AND how many launched
+      device chunks stay in flight before the host drains the oldest.
+      0 = no tokenizer thread, every chunk drains before the next launches.
+    - ``pipeline_depth``: how many H2D-staged chunks (``jax.device_put``
+      issued on the transfer thread, compute not yet dispatched) may be
+      held in device memory.  0 = staging runs inline on the calling
+      thread (no transfer thread); the default 2 double-buffers chunk
+      N+1's transfer under chunk N's compute.
+
+    Results are bit-identical at every depth — only scheduling changes.
+    """
+
+    prefetch: int = 2
+    pipeline_depth: int = 2
+
+    def __post_init__(self) -> None:
+        if self.prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {self.prefetch}")
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth must be >= 0, got {self.pipeline_depth}"
+            )
+
+
 class DanglingMode(str, enum.Enum):
     """What happens to rank mass at nodes with no out-links.
 
@@ -229,11 +261,22 @@ class TfidfConfig:
     # Streaming ingest (BASELINE.json:11): docs are fed in fixed-size chunks
     # of this many tokens; 0 = single batch.
     chunk_tokens: int = 0
-    # Double-buffered ingest (SURVEY.md §5.7): how many tokenized chunks the
-    # background tokenizer thread may run ahead of device compute, and how
-    # many launched device chunks stay in flight before the host syncs.
-    # 0 = fully serial (tokenize → compute → pull, one chunk at a time).
+    # Staged ingest pipeline (SURVEY.md §5.7, IngestConfig above): how many
+    # tokenized chunks the background tokenizer thread may run ahead of the
+    # H2D stage / how many launched device chunks stay in flight before the
+    # host syncs (prefetch), and how many H2D-staged chunks the transfer
+    # thread may hold in device memory (pipeline_depth).  0/0 = fully
+    # serial (tokenize → put → compute → pull, one chunk at a time).
     prefetch: int = 2
+    pipeline_depth: int = 2
+    # Re-pack incoming document chunks so each carries ~this many tokens
+    # before padding (dataflow.ingest.pack_doc_chunks): the chunk kernel
+    # sorts/reduces the PADDED arrays, so half-full chunks pay ~2x the
+    # batch pipeline's compute — most of the measured streaming-vs-batch
+    # gap (BENCH_r07).  0 = take the caller's chunking as-is.  Documents
+    # never split, so results are identical either way; checkpoint chunk
+    # indices count PACKED chunks (resume with the same target).
+    pack_target_tokens: int = 0
     checkpoint_every: int = 0  # chunks between checkpoints (0 = off)
     checkpoint_dir: str | None = None
     dtype: str = "float32"
@@ -245,6 +288,14 @@ class TfidfConfig:
             raise ValueError(f"ngram must be 1 or 2, got {self.ngram}")
         if self.prefetch < 0:
             raise ValueError(f"prefetch must be >= 0, got {self.prefetch}")
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth must be >= 0, got {self.pipeline_depth}"
+            )
+        if self.pack_target_tokens < 0:
+            raise ValueError(
+                f"pack_target_tokens must be >= 0, got {self.pack_target_tokens}"
+            )
         object.__setattr__(self, "tf_mode", TfMode(self.tf_mode))
         object.__setattr__(self, "idf_mode", IdfMode(self.idf_mode))
 
@@ -252,12 +303,19 @@ class TfidfConfig:
     def vocab_size(self) -> int:
         return 1 << self.vocab_bits
 
+    def ingest(self) -> IngestConfig:
+        """The staged-pipeline knobs as the dataflow core's IngestConfig."""
+        return IngestConfig(prefetch=self.prefetch,
+                            pipeline_depth=self.pipeline_depth)
+
     def config_hash(self) -> str:
         """Semantic fields only (chunking/checkpoint placement excluded —
         the accumulated DF/TF state is chunk-boundary-independent)."""
         return _hash_config(
             self,
-            exclude={"chunk_tokens", "prefetch", "checkpoint_every", "checkpoint_dir"},
+            exclude={"chunk_tokens", "prefetch", "pipeline_depth",
+                     "pack_target_tokens", "checkpoint_every",
+                     "checkpoint_dir"},
         )
 
 

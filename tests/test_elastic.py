@@ -381,7 +381,11 @@ def test_tfidf_sharded_survives_device_loss_2to1(tmp_path):
     assert len(rep["mesh_shrinks"]) == 1
     s = rep["mesh_shrinks"][0]
     assert (s["devices_old"], s["devices_new"]) == (2, 1)
-    assert s["site"] == "tfidf_shard_sync"
+    # the staged pipeline attributes the shrink to the site the loss
+    # surfaced at: one of the ISSUE 10 H2D staging sites, or the guarded
+    # drain pull for a loss first seen there
+    assert s["site"] in ("ingest_h2d_put", "ingest_h2d_wait",
+                         "tfidf_shard_sync")
     assert not rep["exhausted"]
 
 

@@ -430,6 +430,67 @@ def test_unguarded_sync_suppressed(tmp_path):
     )
 
 
+# ------------------------------------- rule 12: sync-put-in-ingest-loop
+
+
+SYNC_PUT_LOOP_TP = """
+import jax
+
+def ingest(chunks, esh):
+    out = []
+    for chunk in chunks:
+        out.append(jax.device_put(chunk, esh))  # raw per-chunk H2D
+    return out
+"""
+
+SYNC_PUT_LOOP_TN = """
+import jax
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.ingest import staged_put
+
+def ingest(chunks, esh, metrics):
+    out = []
+    for chunk in chunks:
+        out.append(staged_put(lambda: jax.device_put(chunk, esh),
+                              metrics=metrics))  # the staging API
+    graph = jax.device_put(chunks[0])  # one-time put outside any loop
+    return out, graph
+"""
+
+SYNC_PUT_LOOP_SUPPRESSED = """
+import jax
+
+def ingest(chunks):
+    for chunk in chunks:
+        jax.device_put(chunk)  # graftlint: disable=sync-put-in-ingest-loop (rare recovery path, one put per shrink)
+"""
+
+
+def test_sync_put_in_ingest_loop_true_positive(tmp_path):
+    findings = [f for f in lint_models_snippet(tmp_path, SYNC_PUT_LOOP_TP)
+                if f.rule == "sync-put-in-ingest-loop"]
+    assert len(findings) == 1
+
+
+def test_sync_put_in_ingest_loop_true_negative(tmp_path):
+    assert "sync-put-in-ingest-loop" not in rules_hit(
+        lint_models_snippet(tmp_path, SYNC_PUT_LOOP_TN)
+    )
+
+
+def test_sync_put_in_ingest_loop_ignores_other_directories(tmp_path):
+    """Raw in-loop puts are legal outside dataflow//models//parallel/
+    (e.g. tools/ micro-benchmarks, the serving warmup loop)."""
+    f = tmp_path / "snippet.py"
+    f.write_text(SYNC_PUT_LOOP_TP)
+    assert "sync-put-in-ingest-loop" not in rules_hit(lint_file(f, tmp_path))
+
+
+def test_sync_put_in_ingest_loop_suppressed(tmp_path):
+    assert "sync-put-in-ingest-loop" not in rules_hit(
+        lint_models_snippet(tmp_path, SYNC_PUT_LOOP_SUPPRESSED)
+    )
+
+
 # ------------------------------------------------- rule 7: untraced spans
 
 
@@ -776,6 +837,7 @@ def test_every_rule_has_summary():
         "unsynced-thread-state",
         "env-knob-drift",
         "ladder-rung-drift",
+        "sync-put-in-ingest-loop",
     }
     for rule in RULES.values():
         assert rule.summary

@@ -317,7 +317,10 @@ def chunks():
 
 
 obs.start_run("killtest")
-run_tfidf_streaming(chunks(), TfidfConfig(vocab_bits=8, prefetch=0))
+# fully serial (no tokenize or H2D run-ahead): the kill at chunk 12 must
+# land with exactly chunks 0..11 drained, so the accounting pin is exact
+run_tfidf_streaming(chunks(), TfidfConfig(vocab_bits=8, prefetch=0,
+                                          pipeline_depth=0))
 """
 
 
@@ -360,8 +363,11 @@ def test_sigkilled_chaos_run_leaves_full_accounting(tmp_path):
     assert rep["chaos"].get("tfidf_chunk_sync", 0) >= 2
     assert rep["retries"].get("tfidf_chunk_sync", 0) >= 2
     # (c) the last incomplete span names the phase the process died inside
+    # — since the staged pipeline (ISSUE 10) that is the ingest *stage*
+    # the kill landed in (the source dies mid-tokenize), with the
+    # enclosing tfidf.stream phase still on record as incomplete
     assert rep["last_incomplete"] is not None
-    assert rep["last_incomplete"]["name"] == "tfidf.stream"
+    assert rep["last_incomplete"]["name"] == "ingest.tokenize"
     assert "tfidf.stream" in rep["incomplete_phases"]
 
     manifests = sorted(tmp_path.glob("killtest.*.manifest.json"))

@@ -476,6 +476,9 @@ def test_bench_forced_tfidf_timeout_emits_partial_record():
         BENCH_PROBE_TIMEOUT_S="90",
         BENCH_TFIDF_DOCS="256", BENCH_TFIDF_TOKENS_PER_DOC="30",
         BENCH_TFIDF_CHUNK_DOCS="16",  # -> 16 streaming chunks
+        BENCH_TFIDF_PACK_TOKENS="0",  # keep them 16: the cap-filling
+        # re-pack would fold this tiny corpus into ONE chunk and the
+        # hang below could never fire mid-stream
         BENCH_TFIDF_CKPT_EVERY="1",   # chunk-granular resume for this test
         BENCH_TFIDF_TIMEOUT_S="30", BENCH_TFIDF_RETRIES="1",
         # every chunk drain from the 8th on hangs "forever": the child

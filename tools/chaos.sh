@@ -167,3 +167,76 @@ assert any(d == "ppr_step" for d in rep["degraded"]), rep["degraded"]
 print("dataflow fixpoint scenario: OK — sharded tol-fixpoint shrank 2->1 "
       "and the batched-PPR fixpoint salvaged through the shared ladder")
 EOF
+
+# ---------------------------------------------------------------------------
+# staged-ingest H2D scenario (ISSUE 10): device_lost injected at the new
+# ingest_h2d_put staging site — a fault on an IN-FLIGHT staged chunk —
+# must walk the elastic rung on both ingest paths: the single-chip
+# streaming pipeline rolls back to its last commit and replays the
+# retained host chunks on the CPU rung; the 2-device sharded pipeline
+# shrinks its mesh and re-slices the in-flight staged groups over the
+# survivor.  Outputs must match uninterrupted runs; the trace must carry
+# the per-stage ingest accounting (h2d_overlap_frac) for both.
+echo "== chaos: device_lost at ingest_h2d_put (staged ingest, both paths) =="
+ingest_dir=$(mktemp -d)
+trap 'rm -rf "$scenario_dir" "$dflow_dir" "$ingest_dir"' EXIT
+env -u PALLAS_AXON_POOL_IPS \
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    GRAFT_TRACE_DIR="$ingest_dir" \
+    SCENARIO_DIR="$ingest_dir" \
+    python - <<'EOF'
+import glob
+import os
+import sys
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    run_tfidf_streaming,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.parallel import run_tfidf_sharded
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+
+sys.path.insert(0, "tools")  # chaos.sh runs from the repo root
+import trace_report
+
+chunks = [[f"tok{i} tok{i % 5} shared word extra{i % 3}"
+           for i in range(j * 2, (j + 1) * 2)] for j in range(12)]
+
+# uninterrupted references, BEFORE the chaos plan is installed
+cfg = TfidfConfig(vocab_bits=10, prefetch=2, pipeline_depth=2)
+base_stream = run_tfidf_streaming(iter(chunks), cfg)
+base_shard = run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10),
+                               n_devices=2)
+
+run = obs.start_run("chaos_ingest_h2d", os.environ["SCENARIO_DIR"])
+
+# single-chip: device 0 dies at the H2D put -> CPU rung, rollback+replay
+os.environ["GRAFT_CHAOS"] = "ingest_h2d_put:device_lost@dev:0"
+res = run_tfidf_streaming(iter(chunks), cfg)
+assert res.to_dense().tobytes() == base_stream.to_dense().tobytes()
+
+# 2-device sharded: device 1 dies at the sharded put -> mesh shrink 2->1,
+# in-flight staged groups re-sliced from retained host corpora
+elastic.reset_health()
+os.environ["GRAFT_CHAOS"] = "ingest_h2d_put:device_lost@dev:1"
+tf = run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10), n_devices=2)
+np.testing.assert_allclose(tf.to_dense(), base_shard.to_dense(), atol=1e-6)
+obs.end_run()
+
+rep = trace_report.report(glob.glob(os.path.join(
+    os.environ["SCENARIO_DIR"], "chaos_ingest_h2d.*.trace.jsonl"))[0])
+shrinks = rep["mesh_shrinks"]
+assert len(shrinks) == 1 and (
+    shrinks[0]["devices_old"], shrinks[0]["devices_new"]) == (2, 1), shrinks
+assert shrinks[0]["site"] == "ingest_h2d_put", shrinks
+assert rep["degraded"].get("ingest_h2d_put", 0) >= 2, rep["degraded"]
+assert not rep["exhausted"], rep["exhausted"]
+assert rep["ingest"] and all("h2d_overlap_frac" in r for r in rep["ingest"])
+print("staged-ingest scenario: OK — single-chip rolled back+replayed on "
+      "the cpu rung, sharded shrank 2->1 re-slicing staged groups "
+      f"(ingest runs traced: {len(rep['ingest'])})")
+EOF

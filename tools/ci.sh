@@ -51,7 +51,10 @@ echo "== trace-diff gate (per-phase regression across committed rounds) =="
 # itself before the next one lands on top.  ENFORCING since ISSUE 8: the
 # two newest committed rounds (r06+) carry extra.breakdown, so rc=2 — a
 # round missing its breakdown — is itself a regression (the bench lost
-# its accounting), not a soft skip.
+# its accounting), not a soft skip.  Since ISSUE 10 trace_diff folds the
+# overlapped staged-ingest phases (ingest.h2d + ingest.compute) before
+# comparing, so wall time moving from compute into overlapped H2D — the
+# double-buffering landing — can never read as a false regression.
 # `|| true`: zero matching rounds must take the skip branch below, not
 # kill the script via set -e/pipefail; sort -V keeps r100 after r99
 rounds=$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -2 || true)
@@ -101,9 +104,15 @@ assert rep["complete"], f"traced run did not finish: {rep}"
 assert "tfidf.stream" in rep["breakdown"], rep["breakdown"]
 assert len(rep["chunks"]) == 3 and all(c["complete"] for c in rep["chunks"]), rep["chunks"]
 assert rep["manifest"] and rep["manifest"]["status"] == "ok", rep["manifest"]
+# the staged ingest pipeline (ISSUE 10) must leave its per-stage
+# accounting in the artifact: one ingest_overlap record per run with the
+# tokenize/h2d/compute split and the h2d_overlap_frac gauge
+assert rep.get("ingest"), rep.get("ingest")
+assert all("h2d_overlap_frac" in r for r in rep["ingest"]), rep["ingest"]
 print("traced-run smoke: OK "
       f"({rep['events']} events, {len(rep['chunks'])} chunks, "
-      f"wall {rep['wall_secs']:.3f}s)")
+      f"wall {rep['wall_secs']:.3f}s, "
+      f"h2d_overlap {rep['ingest'][-1]['h2d_overlap_frac']})")
 EOF
 
 echo "== chaos gate (tier-1 under *:fail@%5 + device_lost mesh-shrink scenario) =="

@@ -65,12 +65,34 @@ def load_breakdown(path: str) -> tuple[dict[str, float], float | None, str]:
     )
 
 
+# Phases of the staged ingest pipeline (ISSUE 10) that overlap BY DESIGN:
+# the H2D staging stage runs under chunk compute, so wall time moving from
+# ``ingest.compute`` into ``ingest.h2d`` is the optimization landing, not a
+# regression.  They are folded into one combined phase before the per-phase
+# comparison; the detailed split lives in trace_report's ingest section.
+_OVERLAPPED_FOLD = {
+    "ingest.h2d": "ingest.h2d+compute",
+    "ingest.compute": "ingest.h2d+compute",
+}
+
+
+def _fold_overlapped(bd: dict[str, float]) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for phase, secs in bd.items():
+        key = _OVERLAPPED_FOLD.get(phase, phase)
+        out[key] = out.get(key, 0.0) + secs
+    return out
+
+
 def diff_breakdowns(
     old: dict[str, float], new: dict[str, float]
 ) -> list[dict]:
     """Per-phase rows sorted by absolute regression (worst first).  Phases
     present on only one side diff against 0 — a phase appearing or
-    disappearing IS an attribution, not an error."""
+    disappearing IS an attribution, not an error.  Overlapped ingest
+    stages are folded first (``_OVERLAPPED_FOLD``)."""
+    old = _fold_overlapped(old)
+    new = _fold_overlapped(new)
     rows = []
     for phase in sorted(set(old) | set(new)):
         a, b = old.get(phase, 0.0), new.get(phase, 0.0)

@@ -249,6 +249,19 @@ def report(path: str) -> dict[str, Any]:
             "thread": deepest.get("thread"),
         }
 
+    # Staged-ingest pipeline accounting (ISSUE 10): chunked_ingest
+    # publishes one ``ingest_overlap`` event per run with the per-stage
+    # wall seconds (tokenize / H2D staging / compute) and the
+    # h2d_overlap_frac gauge — the fraction of H2D staging time spent
+    # while chunk compute was in flight.  A traced process may hold
+    # several ingest runs (the bench child runs serial + pipelined
+    # passes); each is reported, in order.
+    ingest_runs = [
+        {k: v for k, v in e.items() if k not in ("kind", "t", "thread")}
+        for e in events
+        if e["kind"] == "ingest_overlap"
+    ]
+
     # Serving-path accounting (ISSUE 8): per-request ``serve_request``
     # events carry queue-wait and total latency; the serve.pad/dispatch/
     # pull spans give the phase split.  Present only for serve runs.
@@ -299,6 +312,7 @@ def report(path: str) -> dict[str, Any]:
         ),
         "wall_secs": wall,
         "breakdown": breakdown,
+        "ingest": ingest_runs or None,
         "incomplete_phases": incomplete_phases,
         "spans": span_stats,
         "chunks": chunks,
@@ -444,6 +458,18 @@ def render_human(rep: dict[str, Any]) -> str:
             pct = 100.0 * secs / rep["wall_secs"] if rep["wall_secs"] > 0 else 0.0
             lines.append(f"  {name:32s} {secs:10.3f}s {pct:5.1f}%{mark}")
         lines.append(f"  {'(phases total)':32s} {total:10.3f}s")
+    if rep.get("ingest"):
+        lines.append("ingest pipeline (staged: tokenize | h2d | compute):")
+        for run in rep["ingest"]:
+            lines.append(
+                f"  {run.get('chunks', '?'):>4} chunk(s)  "
+                f"tokenize {run.get('tokenize_secs', 0.0):8.3f}s  "
+                f"h2d {run.get('h2d_secs', 0.0):8.3f}s  "
+                f"compute {run.get('compute_secs', 0.0):8.3f}s  "
+                f"h2d_overlap {100.0 * run.get('h2d_overlap_frac', 0.0):5.1f}%"
+                f"  (prefetch={run.get('depth')}, "
+                f"pipeline_depth={run.get('pipeline_depth')})"
+            )
     if rep["chunks"]:
         done = [c for c in rep["chunks"] if c["complete"]]
         lines.append(
