@@ -661,6 +661,25 @@ def _measure_workloads_traced(obs) -> dict:
     return out
 
 
+def measure_soak() -> dict:
+    """Production-soak child (ISSUE 11): continuous streaming ingest +
+    index rebuild/hot-swap + mixed tfidf/bm25/@prior closed-loop traffic
+    + background PageRank-prior refresh + deterministic chaos (>=1
+    injected device loss), scored on SLOs — served p50/p99 under ingest
+    load, error-budget burn, time-to-recover, and the zero-dropped /
+    zero-double-served invariants.  Shaped by the GRAFT_SOAK_* env knobs
+    (duration/QPS/SLO targets); emits ONE ``slo`` record the parent
+    copies into ``extra.slo`` and trace_diff regresses across rounds."""
+    from page_rank_and_tfidf_using_apache_spark_tpu import obs
+    from page_rank_and_tfidf_using_apache_spark_tpu.serving.soak import (
+        SoakConfig,
+        run_soak,
+    )
+
+    with obs.run("soak"):
+        return run_soak(SoakConfig.from_env())
+
+
 def measure_tfidf_sharded() -> dict:
     """Sharded (multi-device) ingest throughput — the ROADMAP's
     ``tfidf_sharded_tokens_per_sec``, null in every round before this
@@ -1037,6 +1056,7 @@ def _main(graph_cache: str) -> int:
     sharded_out = None
     serve_out = None
     workloads_out = None
+    soak_out = None
     tfidf_record: dict = {}
     if not os.environ.get("BENCH_SKIP_TFIDF"):
         import shutil
@@ -1106,6 +1126,17 @@ def _main(graph_cache: str) -> int:
             os.unlink(corpus_cache)
             shutil.rmtree(ck_dir, ignore_errors=True)
 
+    # Production soak (ISSUE 11): the SLO-scored long-running composition
+    # (continuous ingest + live mixed traffic + chaos).  Independent of
+    # the corpus caches above — it streams its own growing corpus.
+    # Timeout = soak duration + generous setup margin; skip with
+    # BENCH_SKIP_SOAK=1.
+    if not os.environ.get("BENCH_SKIP_SOAK"):
+        soak_s = float(os.environ.get("GRAFT_SOAK_DURATION_S", "60"))
+        soak_timeout = int(os.environ.get(
+            "BENCH_SOAK_TIMEOUT_S", str(int(3 * soak_s + 240))))
+        soak_out = _run_child("soak", soak_timeout, child_env)
+
     # --- sklearn anchor for TF-IDF (same corpus would be ideal but costs
     # parent time; a fixed-rate anchor is recorded by tools/ when needed) ---
     extra: dict = {"tpu_unreachable": not tpu_alive, "backend": backend_used,
@@ -1137,6 +1168,14 @@ def _main(graph_cache: str) -> int:
                     "bm25_vs_tfidf_served_qps"):
             if workloads_out.get(key) is not None:
                 extra[key] = workloads_out[key]
+    # Always present so rounds are comparable (null = the soak child did
+    # not produce a record this round): the ISSUE 11 SLO record — served
+    # p50/p99 under ingest load, error-budget burn, time-to-recover,
+    # dropped/double-served counts.  tools/trace_diff.py regresses this
+    # block between committed rounds.
+    extra["slo"] = None
+    if soak_out:
+        extra["slo"] = soak_out
     # Always present so rounds are comparable: null = the sharded child
     # did not produce a number this round.
     extra["tfidf_sharded_tokens_per_sec"] = None
@@ -1231,6 +1270,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1] == "--serve":
         print(json.dumps(measure_serve()))
+        sys.exit(0)
+    if len(sys.argv) == 2 and sys.argv[1] == "--soak":
+        print(json.dumps(measure_soak()))
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1] == "--workloads":
         print(json.dumps(measure_workloads()))

@@ -48,16 +48,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-query-terms", type=int, default=16)
     p.add_argument("--cache-size", type=int, default=1024,
                    help="hot-query LRU entries (0 disables)")
-    p.add_argument("--ranker", choices=["tfidf", "bm25"], default="tfidf",
+    p.add_argument("--ranker", choices=["tfidf", "bm25", "prior"],
+                   default="tfidf",
                    help="default scoring weights per request (the index "
                         "must bundle BM25 weights for bm25 — cli.tfidf "
-                        "--save-index does by default).  A query line may "
-                        "override per request with an '@tfidf '/'@bm25 ' "
+                        "--save-index does by default; 'prior' blends the "
+                        "index's PageRank prior per request, needs "
+                        "--prior-alpha > 0).  A query line may override "
+                        "per request with an '@tfidf '/'@bm25 '/'@prior ' "
                         "prefix — the A/B switch.")
     p.add_argument("--rank-alpha", type=float, default=0.0,
-                   help="blend the index's PageRank prior into scores "
-                        "(score + alpha * rank; needs an index built with "
-                        "ranks)")
+                   help="blend the index's PageRank prior into EVERY "
+                        "request (score + alpha * rank; needs an index "
+                        "built with ranks)")
+    p.add_argument("--prior-alpha", type=float, default=0.0,
+                   help="per-REQUEST PageRank-prior scale: enables the "
+                        "'prior' ranker (@prior prefix) for exactly the "
+                        "queries that opt in")
     p.add_argument("--no-mmap", action="store_true",
                    help="copy the index into RAM instead of mapping it")
     p.add_argument("--trace-dir", default=None,
@@ -80,7 +87,13 @@ def _main(args) -> int:
         max_query_terms=args.max_query_terms,
         cache_size=args.cache_size,
         rank_alpha=args.rank_alpha,
+        prior_alpha=args.prior_alpha,
     )
+    # Live SLO telemetry (ISSUE 11): with GRAFT_METRICS_PORT set, the
+    # serve process exposes /snapshot.json + /metrics over the default
+    # hub (fed from the bus's serve_request events) — inspect it while it
+    # runs with tools/slo_watch.py.
+    exporter = obs.export.serve_metrics_from_env()
     source = sys.stdin if args.queries == "-" else open(args.queries)
     lat: list[float] = []
     try:
@@ -97,7 +110,7 @@ def _main(args) -> int:
                 if not terms:
                     continue
                 ranker = args.ranker
-                if terms[0] in ("@tfidf", "@bm25"):  # per-request A/B
+                if terms[0] in ("@tfidf", "@bm25", "@prior"):  # per-request A/B
                     ranker = terms[0][1:]
                     terms = terms[1:]
                     if not terms:
@@ -126,6 +139,8 @@ def _main(args) -> int:
     finally:
         if source is not sys.stdin:
             source.close()
+        if exporter is not None:
+            exporter.stop()
     stats["p50_ms"], stats["p99_ms"] = _percentiles_ms(lat)
     print(json.dumps(stats), file=sys.stderr)
     return 0

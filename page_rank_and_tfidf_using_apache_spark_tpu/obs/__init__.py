@@ -53,13 +53,36 @@ from page_rank_and_tfidf_using_apache_spark_tpu.obs.runtime import (
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.obs.trace import SpanTracer
 
+# Live SLO instruments (ISSUE 11): rolling-window histograms / error
+# budgets (obs.metrics) and the pull-based HTTP snapshot surface
+# (obs.export).  Imported after runtime so their obs-package imports see
+# a fully-initialized module.
+from page_rank_and_tfidf_using_apache_spark_tpu.obs import export  # noqa: E402
+from page_rank_and_tfidf_using_apache_spark_tpu.obs import metrics  # noqa: E402
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.metrics import (  # noqa: E402
+    ErrorBudget,
+    MetricsHub,
+    RollingHistogram,
+    StreamingHistogram,
+    TelemetrySink,
+    WindowedCounter,
+)
+
 __all__ = [
     "Aggregates",
+    "ErrorBudget",
     "EventBus",
     "JsonlSink",
     "MemorySink",
+    "MetricsHub",
+    "RollingHistogram",
     "Run",
     "SpanTracer",
+    "StreamingHistogram",
+    "TelemetrySink",
+    "WindowedCounter",
+    "export",
+    "metrics",
     "bus",
     "counter",
     "current_run",

@@ -160,21 +160,26 @@ class MemorySink:
         return [e for e in self.events if e["kind"] == kind]
 
 
-# Histograms keep raw observations up to this many samples (enough for any
-# realistic per-chunk series); past it, only the running count/sum/min/max
-# stay exact and the percentiles degrade to the retained prefix.
-_HIST_CAP = 16384
-
-
 class Aggregates:
-    """Run-scoped counters, gauges and histograms, summarized at run end."""
+    """Run-scoped counters, gauges and histograms, summarized at run end.
+
+    Since ISSUE 11 the histograms are O(bins) streaming instruments
+    (:class:`obs.metrics.StreamingHistogram`) instead of retained sample
+    lists: count/sum/min/max/mean stay exact over a soak-length run,
+    quantiles are correct to within one geometric bin, and memory never
+    grows with the event count (pinned by the 10^6-event regression test
+    in tests/test_slo_metrics.py)."""
 
     def __init__(self) -> None:
+        from page_rank_and_tfidf_using_apache_spark_tpu.obs.metrics import (
+            StreamingHistogram,
+        )
+
+        self._make_hist = StreamingHistogram
         self._lock = threading.Lock()
         self._counters: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
-        self._hists: dict[str, list[float]] = {}
-        self._hist_stats: dict[str, list[float]] = {}  # count, sum, min, max
+        self._hists: dict[str, Any] = {}
 
     def counter(self, name: str, n: float = 1) -> None:
         with self._lock:
@@ -185,31 +190,15 @@ class Aggregates:
             self._gauges[name] = float(value)
 
     def histogram(self, name: str, value: float) -> None:
-        value = float(value)
         with self._lock:
-            stats = self._hist_stats.setdefault(name, [0, 0.0, value, value])
-            stats[0] += 1
-            stats[1] += value
-            stats[2] = min(stats[2], value)
-            stats[3] = max(stats[3], value)
-            samples = self._hists.setdefault(name, [])
-            if len(samples) < _HIST_CAP:
-                samples.append(value)
+            hist = self._hists.get(name)
+            if hist is None:
+                hist = self._hists[name] = self._make_hist()
+        hist.observe(float(value))
 
     def summary(self) -> dict[str, Any]:
         with self._lock:
-            hists = {}
-            for name, (count, total, lo, hi) in self._hist_stats.items():
-                samples = sorted(self._hists.get(name, []))
-                hists[name] = {
-                    "count": int(count),
-                    "sum": total,
-                    "min": lo,
-                    "max": hi,
-                    "mean": total / count if count else 0.0,
-                    "p50": samples[len(samples) // 2] if samples else 0.0,
-                    "p90": samples[(len(samples) * 9) // 10] if samples else 0.0,
-                }
+            hists = {name: h.snapshot() for name, h in self._hists.items()}
             return {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),

@@ -26,6 +26,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.serving.artifact import (
     save_index,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+    RANKERS,
     ServeConfig,
     TfidfServer,
     batch_cap,
@@ -33,11 +34,24 @@ from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
 )
 
 __all__ = [
+    "RANKERS",
     "ServableIndex",
     "ServeConfig",
+    "SoakConfig",
     "TfidfServer",
     "batch_cap",
     "load_index",
+    "run_soak",
     "save_index",
     "serve_pad_plan",
 ]
+
+
+def __getattr__(name: str):
+    # serving.soak pulls in models/ and io/ (the ingest + PageRank side);
+    # lazy so plain serving users don't pay its import chain.
+    if name in ("SoakConfig", "run_soak"):
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import soak
+
+        return getattr(soak, name)
+    raise AttributeError(name)

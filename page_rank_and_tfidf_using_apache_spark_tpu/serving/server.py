@@ -71,7 +71,12 @@ class ServeConfig:
     queue_depth: int = 64  # bound on submitted-but-undrained requests
     flush_ms: float = 2.0  # how long the drain waits to fill a batch
     cache_size: int = 1024  # LRU entries (0 disables the result cache)
-    rank_alpha: float = 0.0  # additive PageRank-prior scale (0 = off)
+    rank_alpha: float = 0.0  # additive PageRank-prior scale (0 = off),
+    # applied to EVERY request (the server-level blend)
+    prior_alpha: float = 0.0  # per-REQUEST PageRank-prior scale: > 0
+    # enables ranker="prior" (tfidf weights + prior_alpha * ranks for
+    # exactly the requests that opt in); the prior rides as a traced
+    # operand, so the compiled batch matrix is shared with tfidf/bm25
 
     def __post_init__(self) -> None:
         if self.top_k < 1:
@@ -84,8 +89,10 @@ class ServeConfig:
             )
         if self.queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {self.queue_depth}")
-        if self.cache_size < 0 or self.rank_alpha < 0:
-            raise ValueError("cache_size and rank_alpha must be >= 0")
+        if self.cache_size < 0 or self.rank_alpha < 0 or self.prior_alpha < 0:
+            raise ValueError(
+                "cache_size, rank_alpha and prior_alpha must be >= 0"
+            )
 
 
 def batch_cap(b: int, max_batch: int, metrics: MetricsRecorder) -> int:
@@ -127,7 +134,11 @@ def serve_pad_plan(
     return [("serve", pad_frac)]
 
 
-RANKERS = ("tfidf", "bm25")
+# "prior" scores with the tfidf weight table plus the per-request
+# PageRank-prior blend (ServeConfig.prior_alpha) — the third traffic class
+# of the soak's mixed workload.  All rankers share every compiled
+# executable: the weight table AND the prior vector are traced operands.
+RANKERS = ("tfidf", "bm25", "prior")
 
 
 class _Pending:
@@ -164,6 +175,12 @@ class _Pending:
     def done(self) -> bool:
         """True once the request resolved or failed (non-blocking)."""
         return self._event.is_set()
+
+    @property
+    def error(self) -> BaseException | None:
+        """The failure that resolved this request, or None (non-blocking;
+        the soak's double-serve audit inspects abandoned futures)."""
+        return self._error
 
     @property
     def latency_s(self) -> float | None:
@@ -206,10 +223,10 @@ class TfidfServer:
     ):
         if index.n_docs < 1 or index.nnz < 1:
             raise ValueError("cannot serve an empty index")
-        if cfg.rank_alpha > 0 and index.ranks is None:
+        if (cfg.rank_alpha > 0 or cfg.prior_alpha > 0) and index.ranks is None:
             raise ValueError(
-                "rank_alpha > 0 needs a PageRank prior in the index "
-                "(save_index(..., ranks=...))"
+                "rank_alpha/prior_alpha > 0 needs a PageRank prior in the "
+                "index (save_index(..., ranks=...))"
             )
         self.index = index
         self.cfg = cfg
@@ -229,7 +246,10 @@ class TfidfServer:
         self._submit_lock = threading.Lock()
         self._stats = collections.Counter()
         self._dev: tuple | None = None  # device-resident postings
-        self._prior = None
+        self._prior = None  # every-request prior operand (rank_alpha blend)
+        self._prior_req = None  # ranker="prior" operand (+= prior_alpha)
+        self._prior_gen = 0  # bumped per operand swap; stale-cache guard
+        self._use_prior = False
         self._runner = None
 
     # ------------------------------------------------------------ lifecycle
@@ -262,18 +282,19 @@ class TfidfServer:
                         idx.bm25_weight.astype(idx.weight.dtype)
                     )
                 )
-            prior_np = (
-                (self.cfg.rank_alpha * np.ascontiguousarray(idx.ranks))
-                if self.cfg.rank_alpha > 0
-                else np.zeros(idx.n_docs, idx.weight.dtype)
+            self._use_prior = (
+                self.cfg.rank_alpha > 0 or self.cfg.prior_alpha > 0
             )
-            self._prior = jnp.asarray(prior_np.astype(idx.weight.dtype))
+            self._set_prior_arrays(
+                np.ascontiguousarray(idx.ranks)
+                if idx.ranks is not None else None
+            )
         self._runner = functools.partial(
             ops.score_query_batch,
             n_docs=idx.n_docs,
             vocab=idx.vocab_size,
             k=self.k,
-            use_prior=self.cfg.rank_alpha > 0,
+            use_prior=self._use_prior,
         )
         self._started = True
         if warm:
@@ -307,6 +328,57 @@ class TfidfServer:
                     out, site="serve_warmup", metrics=self.metrics
                 )
         return caps
+
+    def _set_prior_arrays(self, ranks: np.ndarray | None) -> None:
+        """(Re)build the two device-resident prior operands from a host
+        ranks vector: the every-request blend (``rank_alpha * ranks``) and
+        the ranker="prior" blend (``(rank_alpha + prior_alpha) * ranks``).
+        Zeros when the server carries no prior."""
+        import jax.numpy as jnp
+
+        dtype = self.index.weight.dtype
+        n = self.index.n_docs
+        if ranks is None or not self._use_prior:
+            base = np.zeros(n, dtype)
+            req = base
+        else:
+            ranks = np.ascontiguousarray(ranks, dtype)
+            base = (self.cfg.rank_alpha * ranks if self.cfg.rank_alpha > 0
+                    else np.zeros(n, dtype))
+            req = base + self.cfg.prior_alpha * ranks
+        base_dev = jnp.asarray(base.astype(dtype))
+        req_dev = (base_dev if req is base
+                   else jnp.asarray(req.astype(dtype)))
+        with self._lock:
+            self._prior = base_dev
+            self._prior_req = req_dev
+            self._prior_gen += 1
+
+    def set_prior(self, ranks: np.ndarray) -> None:
+        """Hot-swap the PageRank prior on a RUNNING server (the soak's
+        background refresh): rebuilds the prior operands from ``ranks``
+        and invalidates the result cache (cached top-k blended the old
+        prior).  No recompile — the prior is a traced operand of every
+        warm executable.  Requires a server constructed with
+        ``rank_alpha > 0`` or ``prior_alpha > 0`` (otherwise the compiled
+        program has no prior addend to feed)."""
+        if not self._started:
+            raise RuntimeError("server not started")
+        if not self._use_prior:
+            raise RuntimeError(
+                "server compiled without a prior operand — construct with "
+                "ServeConfig(rank_alpha=... ) or ServeConfig(prior_alpha=...)"
+            )
+        ranks = np.ascontiguousarray(ranks)
+        if ranks.shape != (self.index.n_docs,):
+            raise ValueError(
+                f"prior has shape {ranks.shape}; this index holds "
+                f"{self.index.n_docs} documents"
+            )
+        self._set_prior_arrays(ranks)
+        with self._lock:
+            self._cache.clear()
+        obs.emit("serve_prior_update", n_docs=int(ranks.shape[0]))
 
     def stop(self) -> None:
         with self._submit_lock:
@@ -388,6 +460,12 @@ class TfidfServer:
                 "save_index(..., bm25=Bm25Config()) / cli.tfidf "
                 "--save-index (BM25 is bundled by default)"
             )
+        if ranker == "prior" and self.cfg.prior_alpha <= 0:
+            raise ValueError(
+                "ranker='prior' needs a per-request prior scale — construct "
+                "the server with ServeConfig(prior_alpha=...) over an index "
+                "saved with a ranks prior"
+            )
         q_term, q_weight = self.make_query(terms)
         pending = _Pending(self.query_key(q_term, q_weight, ranker),
                            q_term, q_weight, ranker)
@@ -435,10 +513,15 @@ class TfidfServer:
                 self._cache.move_to_end(key)
         return hit
 
-    def _cache_put(self, key: bytes, value: tuple) -> None:
+    def _cache_put(self, key: bytes, value: tuple, gen: int) -> None:
         if self.cfg.cache_size <= 0:
             return
         with self._lock:
+            if gen != self._prior_gen:
+                # the batch was dispatched against a prior operand that
+                # set_prior has since hot-swapped: caching it would serve
+                # the stale blend as hits after the invalidation
+                return
             self._cache[key] = value
             self._cache.move_to_end(key)
             while len(self._cache) > self.cfg.cache_size:
@@ -560,12 +643,21 @@ class TfidfServer:
                 q_term[i, :m] = p.q_term[:m]
                 q_weight[i, :m] = p.q_weight[:m]
                 q_valid[i, :m] = 1.0
+        # ranker="prior" is the tfidf table with the per-request prior
+        # operand; tfidf/bm25 ride the every-request (rank_alpha) operand.
+        # The (operand, generation) pair is read atomically so a set_prior
+        # landing mid-batch cannot smuggle this batch's result past its
+        # cache invalidation.
+        table = self._weights["tfidf" if ranker == "prior" else ranker]
+        with self._lock:
+            prior = self._prior_req if ranker == "prior" else self._prior
+            prior_gen = self._prior_gen
         try:
             with obs.span("serve.dispatch", cap=cap, ranker=ranker):
                 scores_dev, idx_dev = rx.run_guarded(
                     lambda: self._runner(
-                        *self._dev, self._weights[ranker], self._valid,
-                        q_term, q_weight, q_valid, self._prior,
+                        *self._dev, table, self._valid,
+                        q_term, q_weight, q_valid, prior,
                     ),
                     site="serve_dispatch", metrics=self.metrics,
                 )
@@ -590,7 +682,7 @@ class TfidfServer:
             return
         for i, key in enumerate(groups):
             result = (scores[i].copy(), idx[i].copy())
-            self._cache_put(key, result)
+            self._cache_put(key, result, prior_gen)
             for p in groups[key]:
                 p._resolve(result)
                 self._publish_request(p, batch=batch_size)

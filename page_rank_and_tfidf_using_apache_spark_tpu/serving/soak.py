@@ -1,0 +1,697 @@
+"""Production soak harness: continuous ingest + live traffic + chaos,
+scored on SLOs (ISSUE 11; the ROADMAP "production soak" composition).
+
+Everything the repo built separately finally runs AT THE SAME TIME, the
+way the "heavy traffic from millions of users" claim implies:
+
+- an **ingest thread** streams a growing synthetic corpus through the
+  staged ``chunked_ingest`` pipeline (``run_tfidf_streaming``) and commits
+  a fresh servable index version every ``rebuild_every_s`` — the
+  full-rebuild ingest→servable path the ROADMAP's delta-segments bullet
+  will later shorten;
+- the supervisor **hot-swaps** each new version under live traffic: the
+  replacement server is built and warmed *before* the flip, the old
+  server drains and fails its leftovers, and the closed-loop clients
+  retry — zero dropped, zero double-served (both *measured*, not
+  assumed);
+- **closed-loop clients** drive mixed ``tfidf`` / ``bm25`` / ``prior``
+  traffic (the per-request PageRank blend) at a target aggregate QPS;
+- a **prior-refresh thread** recomputes PageRank over the document graph
+  and hot-swaps the prior operand on the running server
+  (``TfidfServer.set_prior`` — no recompile, cache invalidated);
+- **deterministic chaos**: any ``GRAFT_CHAOS`` plan stays active
+  throughout (transient faults retry invisibly), and at ``loss_at_s`` the
+  harness composes in a persistent ``serve_dispatch:lost@1+`` — the
+  serving device is gone.  Every batch fails until the supervisor
+  *recovers*: it notices client failures, lifts the dead-device plan
+  (the replacement chip), rebuilds a warm server from the last committed
+  index version, swaps, and probes.  ``time_to_recover_s`` is the
+  measured first-failure → first-served-again span.
+
+Scoring is SLOs, not throughput: served p50/p95/p99 over the rolling
+window (``obs.metrics`` instruments fed by the ``serve_request`` events
+the server already publishes — zero new wiring), availability and
+latency **error budgets** with burn rates, recovery time, and the
+dropped / double-served invariants.  One ``slo`` record is returned (and
+published as an ``slo`` event into the trace, where ``tools/trace_report``
+renders it and ``tools/trace_diff`` regresses it round-over-round).  A
+live :mod:`obs.export` endpoint serves the same window mid-run — the
+record embeds a mid-run endpoint snapshot so the "inspectable while
+running" claim is itself tested.
+
+Env knobs (all declared in ``utils/config.GRAFT_ENV_KNOBS``):
+``GRAFT_SOAK_DURATION_S``, ``GRAFT_SOAK_QPS``, ``GRAFT_SOAK_SLO_P99_MS``,
+``GRAFT_SOAK_SLO_AVAILABILITY``, plus ``GRAFT_METRICS_PORT`` for the
+endpoint.  ``bench.py --soak`` is a thin wrapper over :func:`run_soak`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import queue
+import shutil
+import tempfile
+import threading
+import time
+import urllib.request
+from typing import Any, Iterator
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs, serving
+from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
+    synthetic_powerlaw,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import (
+    run_pagerank,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    run_tfidf_streaming,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.export import (
+    MetricsExporter,
+    metrics_port_from_env,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.metrics import (
+    MetricsHub,
+    TelemetrySink,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    Bm25Config,
+    PageRankConfig,
+    TfidfConfig,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import (
+    MetricsRecorder,
+    percentile,
+)
+
+_VOCAB_WORDS = 20_000
+
+
+@dataclasses.dataclass(frozen=True)
+class SoakConfig:
+    """One soak scenario.  The four starred knobs ride env variables so
+    the bench child and the ci.sh smoke gate can shape a round without
+    code changes; everything else is a library-level parameter."""
+
+    duration_s: float = 60.0  # * GRAFT_SOAK_DURATION_S
+    qps: float = 30.0  # * GRAFT_SOAK_QPS — aggregate closed-loop target
+    slo_p99_ms: float = 500.0  # * GRAFT_SOAK_SLO_P99_MS
+    availability_target: float = 0.999  # * GRAFT_SOAK_SLO_AVAILABILITY
+    clients: int = 3
+    window_s: float = 60.0  # rolling SLO window
+    rebuild_every_s: float = 12.0  # ingest commit -> index version cadence
+    chunk_interval_s: float = 0.5  # corpus arrival pacing
+    prior_refresh_every_s: float = 8.0
+    losses: int = 1  # injected device losses (>=1 per the acceptance bar)
+    loss_at_s: float | None = None  # default duration/3
+    request_timeout_s: float = 20.0
+    retry_limit: int = 200  # per logical request (zero-dropped pressure)
+    grace_s: float = 30.0  # post-deadline window to land in-flight retries
+    seed: int = 7
+    vocab_bits: int = 12
+    docs_per_chunk: int = 24
+    tokens_per_doc: int = 40
+    chunk_tokens: int = 1 << 12
+    bootstrap_chunks: int = 3
+    top_k: int = 10
+    max_batch: int = 8
+    prior_alpha: float = 0.25
+    prior_iters: int = 5
+    metrics_port: int | None = None  # None -> GRAFT_METRICS_PORT else 0
+
+    def __post_init__(self) -> None:
+        if self.duration_s <= 0 or self.qps <= 0 or self.clients < 1:
+            raise ValueError("duration_s, qps and clients must be positive")
+        if not 0.0 < self.availability_target < 1.0:
+            raise ValueError("availability_target must be in (0, 1)")
+        if self.losses < 0 or self.slo_p99_ms <= 0:
+            raise ValueError("losses must be >= 0 and slo_p99_ms > 0")
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "SoakConfig":
+        """Bench/CI entry: the starred knobs from the environment, the
+        rest defaulted (or overridden by the caller)."""
+        env: dict[str, Any] = {}
+        raw = os.environ.get("GRAFT_SOAK_DURATION_S")
+        if raw:
+            env["duration_s"] = float(raw)
+        raw = os.environ.get("GRAFT_SOAK_QPS")
+        if raw:
+            env["qps"] = float(raw)
+        raw = os.environ.get("GRAFT_SOAK_SLO_P99_MS")
+        if raw:
+            env["slo_p99_ms"] = float(raw)
+        raw = os.environ.get("GRAFT_SOAK_SLO_AVAILABILITY")
+        if raw:
+            env["availability_target"] = float(raw)
+        env.update(overrides)
+        return cls(**env)
+
+
+def _doc_chunks(cfg: SoakConfig) -> Iterator[list[str]]:
+    """Endless deterministic Zipf corpus stream, bench-shaped (documents
+    over a shared power-law vocabulary so rebuilt indexes stay
+    queryable by the clients' Zipf query generator)."""
+    rng = np.random.default_rng(cfg.seed)
+    while True:
+        docs = []
+        for _ in range(cfg.docs_per_chunk):
+            n = max(int(rng.poisson(cfg.tokens_per_doc)), 4)
+            ids = rng.zipf(1.3, n) % _VOCAB_WORDS
+            docs.append(" ".join(f"w{i}" for i in ids))
+        yield docs
+
+
+def _prior_ranks(n_docs: int, seed: int, iters: int) -> np.ndarray:
+    """The refreshable PageRank prior: ranks over a synthetic document
+    citation graph at the current corpus size, normalized to mean 1 so
+    the blend scale stays comparable across refreshes."""
+    n = max(int(n_docs), 2)
+    g = synthetic_powerlaw(n, min(6 * n, n * (n - 1)), seed=seed)
+    res = run_pagerank(
+        g,
+        PageRankConfig(iterations=iters, dangling="redistribute",
+                       init="uniform", spmv_impl="segment"),
+    )
+    out = np.zeros(n, np.float32)
+    out[np.asarray(g.node_ids)] = np.asarray(res.ranks, np.float32)
+    mean = float(out.mean())
+    return out / mean if mean > 0 else out
+
+
+def _ms(v: float | None) -> float | None:
+    return None if v is None else round(v * 1e3, 3)
+
+
+class _Soak:
+    """One soak run's mutable state.  The supervisor owns the calling
+    thread; ingest / prior-refresh / client workers are daemon threads.
+    Every cross-thread mutation happens under ``self._lock`` (the
+    ``unsynced-thread-state`` audit surface); the server reference swap
+    is a single atomic rebind readers pick up on their next request."""
+
+    def __init__(self, cfg: SoakConfig, index_dir: str):
+        self.cfg = cfg
+        self.index_dir = index_dir
+        self._lock = threading.Lock()
+        self._stop = threading.Event()  # ingest + prior threads
+        self._client_stop = threading.Event()
+        self._failures: queue.Queue = queue.Queue()
+        self._versions: queue.Queue = queue.Queue()
+        self._server: serving.TfidfServer | None = None
+        self._chaos_ctx: chaos.inject | None = None
+        self._outage = False
+        self._outage_t0: float | None = None
+        self._outage_first_fail: float | None = None
+        self._recoveries: list[dict] = []
+        self._unexpected: list[float] = []
+        self._rebuilds = 0
+        self._prior_refreshes = 0
+        self._client_results: dict[int, list[dict]] = {}
+        self._mid: dict | None = None
+        self._mid_error: dict | None = None
+        self._chunks_arrived = 0
+        self._tokens_arrived = 0
+        self._losses_fired = 0
+        self._t0 = 0.0
+        self._deadline = 0.0
+        self.hub = MetricsHub(
+            window_s=cfg.window_s,
+            latency_slo_s=cfg.slo_p99_ms / 1e3,
+            availability_target=cfg.availability_target,
+        )
+
+    def _stream_cfg(self) -> TfidfConfig:
+        """THE ingest config: bootstrap and every rebuild must build
+        under one identical config (one config hash) or the server would
+        refuse — or worse, silently change semantics — mid-soak."""
+        cfg = self.cfg
+        return TfidfConfig(
+            vocab_bits=cfg.vocab_bits, chunk_tokens=cfg.chunk_tokens,
+            pack_target_tokens=cfg.chunk_tokens, prefetch=2,
+            pipeline_depth=2,
+        )
+
+    def _take_chunk(self, gen: Iterator[list[str]]) -> list[str]:
+        """Pull one arriving doc chunk, counting ARRIVALS — the rebuild
+        passes re-stream the whole accumulated corpus, so the pipeline's
+        own chunk events overcount ingested volume across rebuilds."""
+        docs = next(gen)
+        with self._lock:
+            self._chunks_arrived += 1
+            self._tokens_arrived += sum(len(d.split()) for d in docs)
+        return docs
+
+    # ------------------------------------------------------------ serving
+
+    def _build_server(self) -> serving.TfidfServer:
+        """Load LATEST and stand up a fully-warmed replacement (compiles
+        happen HERE, before any flip — the live server keeps serving)."""
+        index = serving.load_index(self.index_dir)
+        scfg = serving.ServeConfig(
+            top_k=self.cfg.top_k,
+            max_batch=self.cfg.max_batch,
+            queue_depth=max(64, 4 * self.cfg.max_batch),
+            prior_alpha=self.cfg.prior_alpha,
+        )
+        return serving.TfidfServer(index, scfg).start()
+
+    def _swap_server(self, reason: str) -> None:
+        new = self._build_server()
+        with self._lock:
+            old, self._server = self._server, new
+        obs.emit("soak_swap", reason=reason,
+                 version=new.index.version, n_docs=new.index.n_docs)
+        if old is not None:
+            # leftover queued requests fail on stop; their clients retry
+            # against the already-live replacement — served, not dropped
+            old.stop()
+
+    # ------------------------------------------------------------- chaos
+
+    def _fire_loss(self, now_s: float) -> None:
+        env_spec = os.environ.get("GRAFT_CHAOS") or ""
+        spec = ";".join(
+            s for s in (env_spec, "serve_dispatch:lost@1+") if s
+        )
+        ctx = chaos.inject(spec)
+        ctx.__enter__()
+        with self._lock:
+            self._chaos_ctx = ctx
+            self._outage = True
+            self._outage_t0 = time.perf_counter()
+            self._outage_first_fail = None
+            self._losses_fired += 1
+        obs.emit("soak_loss_injected", at_s=round(now_s, 3),
+                 loss=self._losses_fired)
+
+    def _recover(self, reason: str, anchor: float) -> None:
+        """Replace the lost device: lift the dead-device chaos plan (the
+        replacement chip), rebuild a warm server from the last committed
+        index version, swap, and probe until a request is served again.
+        The measured span is anchored at the FIRST observed failure —
+        detection latency is part of the SLO, not an excuse."""
+        with obs.span("soak.recover", reason=reason):
+            with self._lock:
+                ctx, self._chaos_ctx = self._chaos_ctx, None
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+            self._swap_server(reason=f"recover:{reason}")
+            srv = self._server
+            assert srv is not None
+            srv.query(["soak", "recovery", "probe"],
+                      timeout=self.cfg.request_timeout_s)
+        t_rec = time.perf_counter() - anchor
+        with self._lock:
+            self._outage = False
+            self._recoveries.append({
+                "at_s": round(time.perf_counter() - self._t0, 3),
+                "reason": reason,
+                "time_to_recover_s": round(t_rec, 3),
+            })
+        obs.emit("soak_recovered", reason=reason,
+                 time_to_recover_s=round(t_rec, 3))
+        # stale failure notifications from the outage window are handled
+        while True:
+            try:
+                self._failures.get_nowait()
+            except queue.Empty:
+                break
+
+    # ------------------------------------------------------------ threads
+
+    def _ingest_loop(self, gen: Iterator[list[str]],
+                     accum: list[list[str]]) -> None:
+        cfg = self.cfg
+        scfg = self._stream_cfg()
+        next_rebuild = self._t0 + cfg.rebuild_every_s
+        while not self._stop.is_set():
+            accum.append(self._take_chunk(gen))
+            if time.perf_counter() >= next_rebuild:
+                with obs.span("soak.rebuild", chunks=len(accum)):
+                    out = run_tfidf_streaming(
+                        iter(list(accum)), scfg, metrics=MetricsRecorder()
+                    )
+                    ranks = _prior_ranks(out.n_docs, cfg.seed,
+                                         cfg.prior_iters)
+                    path = serving.save_index(
+                        self.index_dir, out, scfg, ranks=ranks,
+                        bm25=Bm25Config(),
+                    )
+                with self._lock:
+                    self._rebuilds += 1
+                obs.emit("soak_rebuild", version=os.path.basename(path),
+                         n_docs=out.n_docs, chunks=len(accum))
+                self._versions.put(path)
+                next_rebuild = time.perf_counter() + cfg.rebuild_every_s
+            else:
+                self._stop.wait(cfg.chunk_interval_s)
+
+    def _prior_loop(self) -> None:
+        cfg = self.cfg
+        k = 0
+        while not self._stop.wait(cfg.prior_refresh_every_s):
+            srv = self._server
+            if srv is None:
+                continue
+            try:
+                n = srv.index.n_docs
+                ranks = _prior_ranks(n, cfg.seed + 1000 + k, cfg.prior_iters)
+                srv.set_prior(ranks)
+                with self._lock:
+                    self._prior_refreshes += 1
+                obs.emit("soak_prior_refresh", n_docs=n, refresh=k)
+            except Exception as exc:  # noqa: BLE001 — the server may have
+                # been swapped/stopped under us; the next tick hits the
+                # replacement
+                obs.emit("soak_prior_refresh_skipped",
+                         error=f"{type(exc).__name__}: {exc}"[:160])
+            k += 1
+
+    def _client_loop(self, idx: int) -> None:
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed * 997 + idx)
+        interval = cfg.clients / cfg.qps
+        next_t = time.perf_counter() + float(rng.uniform(0, interval))
+        # registered up front and appended in place: a client still blocked
+        # in fut.result() past the join timeout must not silently drop its
+        # completed requests from the dropped/double-served audit
+        results: list[dict] = []
+        with self._lock:
+            self._client_results[idx] = results
+        while not self._client_stop.is_set():
+            now = time.perf_counter()
+            if now < next_t:
+                self._client_stop.wait(min(next_t - now, 0.05))
+                continue
+            next_t = max(next_t + interval, now)  # no burst after stalls
+            r = float(rng.random())
+            ranker = "tfidf" if r < 0.5 else ("bm25" if r < 0.8 else "prior")
+            terms = [f"w{int(rng.zipf(1.3)) % _VOCAB_WORDS}"
+                     for _ in range(int(rng.integers(2, 5)))]
+            rec: dict = {"ranker": ranker, "attempts": 0, "ok": False,
+                         "abandoned": []}
+            t_begin = time.perf_counter()
+            hard_deadline = self._deadline + cfg.grace_s
+            while True:
+                rec["attempts"] += 1
+                fut = None
+                try:
+                    srv = self._server
+                    if srv is None:
+                        raise RuntimeError("no live server")
+                    fut = srv.submit(terms, ranker=ranker)
+                    fut.result(cfg.request_timeout_s)
+                    rec["ok"] = True
+                    break
+                except Exception as exc:  # noqa: BLE001 — every failure
+                    # class retries: outage, swap race, queue drain
+                    if fut is not None and not fut.done:
+                        # timed out but still in flight: if the old server
+                        # later resolves it AND the retry also lands, that
+                        # is a double-serve — measured at merge time
+                        rec["abandoned"].append(fut)
+                    self._failures.put((time.perf_counter(), exc))
+                    if (rec["attempts"] >= cfg.retry_limit
+                            or time.perf_counter() >= hard_deadline):
+                        break
+                    time.sleep(0.15)
+            rec["e2e_s"] = time.perf_counter() - t_begin
+            results.append(rec)
+
+    # --------------------------------------------------------- supervisor
+
+    def _maybe_mid_snapshot(self, exporter: MetricsExporter,
+                            now_s: float) -> None:
+        if self._mid is not None or self._outage:
+            return
+        if now_s < self.cfg.duration_s / 2:
+            return
+        direct = self.hub.snapshot()
+        if (direct["latency_s"]["window"]["p99"] is None
+                and now_s < 0.8 * self.cfg.duration_s):
+            return  # no traffic in the window yet; try again shortly
+        try:
+            with urllib.request.urlopen(
+                exporter.url + "/snapshot.json", timeout=5
+            ) as resp:
+                http = json.loads(resp.read())
+        except Exception as exc:  # noqa: BLE001 — endpoint death must not
+            # kill the soak; remember the failure but keep RETRYING on
+            # later ticks (a single timed-out fetch must not latch as the
+            # round's mid snapshot while the endpoint is healthy)
+            self._mid_error = {"at_s": round(now_s, 3),
+                               "error": f"{type(exc).__name__}: {exc}"[:160]}
+            return
+        self._mid = {
+            "at_s": round(now_s, 3),
+            "http_p99_ms": _ms(http["latency_s"]["window"]["p99"]),
+            "direct_p99_ms": _ms(direct["latency_s"]["window"]["p99"]),
+            "window_count": http["latency_s"]["window"]["count"],
+        }
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        sink = TelemetrySink(self.hub)
+        obs.bus().attach(sink)
+        port = (cfg.metrics_port if cfg.metrics_port is not None
+                else (metrics_port_from_env() or 0))
+        exporter = MetricsExporter(self.hub, port=port).start()
+        gen = _doc_chunks(cfg)
+        try:
+            # ---- bootstrap: first index version + first warm server ----
+            with obs.span("soak.bootstrap"):
+                accum = [self._take_chunk(gen)
+                         for _ in range(cfg.bootstrap_chunks)]
+                scfg = self._stream_cfg()
+                out = run_tfidf_streaming(iter(list(accum)), scfg,
+                                          metrics=MetricsRecorder())
+                ranks = _prior_ranks(out.n_docs, cfg.seed, cfg.prior_iters)
+                serving.save_index(self.index_dir, out, scfg, ranks=ranks,
+                                   bm25=Bm25Config())
+                self._server = self._build_server()
+            self._t0 = time.perf_counter()
+            self._deadline = self._t0 + cfg.duration_s
+            obs.emit("soak_start", duration_s=cfg.duration_s, qps=cfg.qps,
+                     clients=cfg.clients, port=exporter.port)
+
+            loss_times = []
+            if cfg.losses > 0:
+                first = (cfg.loss_at_s if cfg.loss_at_s is not None
+                         else cfg.duration_s / 3.0)
+                first = min(first, 0.6 * cfg.duration_s)
+                spacing = max(
+                    (0.6 * cfg.duration_s - first) / max(cfg.losses - 1, 1),
+                    5.0,
+                )
+                loss_times = [first + i * spacing for i in range(cfg.losses)]
+
+            threads = [
+                threading.Thread(target=self._ingest_loop,
+                                 args=(gen, accum), name="soak-ingest",
+                                 daemon=True),
+                threading.Thread(target=self._prior_loop,
+                                 name="soak-prior", daemon=True),
+            ] + [
+                threading.Thread(target=self._client_loop, args=(i,),
+                                 name=f"soak-client-{i}", daemon=True)
+                for i in range(cfg.clients)
+            ]
+            for t in threads:
+                t.start()
+            clients = threads[2:]
+
+            # ---- the supervisor loop (runs through the grace window so
+            # a loss injected late still recovers before scoring) ----
+            while True:
+                now = time.perf_counter()
+                now_s = now - self._t0
+                if now >= self._deadline:
+                    self._client_stop.set()
+                    if all(not c.is_alive() for c in clients):
+                        break
+                    if now >= self._deadline + cfg.grace_s + 5.0:
+                        break  # clients wedged past grace: score what we have
+                if loss_times and now_s >= loss_times[0] and not self._outage:
+                    loss_times.pop(0)
+                    self._fire_loss(now_s)
+                try:
+                    t_fail, _exc = self._failures.get(timeout=0.05)
+                except queue.Empty:
+                    t_fail = None
+                if t_fail is not None:
+                    if self._outage:
+                        if self._outage_first_fail is None:
+                            self._outage_first_fail = t_fail
+                        # the loss has bitten: recover (detection latency
+                        # included in the measured span)
+                        self._recover("device_loss",
+                                      anchor=self._outage_first_fail)
+                    else:
+                        self._unexpected.append(t_fail)
+                        recent = [t for t in self._unexpected
+                                  if now - t < 5.0]
+                        self._unexpected = recent
+                        if len(recent) >= 3:
+                            self._unexpected = []
+                            self._recover("unexpected", anchor=recent[0])
+                swap_to = None
+                while True:  # newest committed version wins
+                    try:
+                        swap_to = self._versions.get_nowait()
+                    except queue.Empty:
+                        break
+                if swap_to is not None and not self._outage:
+                    self._swap_server(reason="rebuild")
+                self._maybe_mid_snapshot(exporter, now_s)
+
+            actual_s = time.perf_counter() - self._t0
+            self._stop.set()
+            threads[0].join(timeout=60.0)
+            threads[1].join(timeout=30.0)
+            for c in clients:
+                c.join(timeout=5.0)
+            time.sleep(0.3)  # let abandoned futures settle before auditing
+            return self._score(actual_s, exporter)
+        finally:
+            self._stop.set()
+            self._client_stop.set()
+            with self._lock:
+                ctx, self._chaos_ctx = self._chaos_ctx, None
+            if ctx is not None:
+                ctx.__exit__(None, None, None)
+            srv, self._server = self._server, None
+            if srv is not None:
+                srv.stop()
+            exporter.stop()
+            obs.bus().detach(sink)
+
+    # ------------------------------------------------------------- scoring
+
+    def _score(self, actual_s: float, exporter: MetricsExporter) -> dict:
+        import jax
+
+        with self._lock:
+            per_client = dict(self._client_results)
+            recoveries = list(self._recoveries)
+            rebuilds = self._rebuilds
+            prior_refreshes = self._prior_refreshes
+            losses_fired = self._losses_fired
+            chunks_arrived = self._chunks_arrived
+            tokens_arrived = self._tokens_arrived
+            mid = self._mid or self._mid_error
+        recs = [r for results in per_client.values() for r in results]
+        dropped = 0
+        double_served = 0
+        mixed: dict[str, int] = {"tfidf": 0, "bm25": 0, "prior": 0}
+        e2e_ok: list[float] = []
+        attempts = 0
+        for r in recs:
+            attempts += r["attempts"]
+            mixed[r["ranker"]] += 1
+            served = int(r["ok"]) + sum(
+                1 for f in r["abandoned"] if f.done and f.error is None
+            )
+            if served == 0:
+                dropped += 1
+            double_served += max(served - 1, 0)
+            if r["ok"]:
+                e2e_ok.append(r["e2e_s"])
+        e2e_ok.sort()
+
+        snap = self.hub.snapshot()
+        win = snap["latency_s"]["window"]
+        tot = snap["latency_s"]["total"]
+        counters = snap["counters"]
+
+        def _ctr(name: str) -> int:
+            return int(counters.get(name, {}).get("total", 0))
+
+        version = 0
+        latest = serving_latest_version(self.index_dir)
+        if latest is not None:
+            version = latest
+        record = {
+            "duration_s": round(actual_s, 3),
+            "requests": len(recs),
+            "attempts": attempts,
+            "qps": round(len(e2e_ok) / actual_s, 3) if actual_s > 0 else 0.0,
+            "served_p50_ms": _ms(win["p50"]),
+            "served_p95_ms": _ms(win["p95"]),
+            "served_p99_ms": _ms(win["p99"]),
+            "served_p99_cumulative_ms": (
+                _ms(tot["p99"]) if tot["count"] else None
+            ),
+            "client_e2e_p99_ms": _ms(percentile(e2e_ok, 0.99)),
+            "error_budget": snap["budgets"],
+            "errors": _ctr("serve.errors"),
+            "recovery": {
+                "losses_injected": losses_fired,
+                "recoveries": recoveries,
+                "time_to_recover_s": (
+                    max(r["time_to_recover_s"] for r in recoveries)
+                    if recoveries else None
+                ),
+            },
+            "dropped": dropped,
+            "double_served": double_served,
+            "ingest": {
+                # ARRIVAL counts — the rebuild passes re-stream the whole
+                # accumulated corpus, so the pipeline's own chunk events
+                # (the hub's ingest.* counters) overcount volume
+                "chunks": chunks_arrived,
+                "tokens": tokens_arrived,
+                "rebuilds": rebuilds,
+                "prior_refreshes": prior_refreshes,
+                "index_version": version,
+            },
+            "chaos_injections": _ctr("chaos.injections"),
+            "chaos_losses": _ctr("chaos.losses"),
+            "mixed_traffic": mixed,
+            "slo_targets": {
+                "p99_ms": self.cfg.slo_p99_ms,
+                "availability": self.cfg.availability_target,
+                "window_s": self.cfg.window_s,
+            },
+            "endpoint": {"port": exporter.port, "mid": mid},
+            "backend": jax.default_backend(),
+        }
+        obs.emit("slo", **record)
+        return record
+
+
+def serving_latest_version(index_dir: str) -> int | None:
+    """Version number behind the LATEST pointer (None when no version
+    has committed yet)."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils import (
+        checkpoint as ckpt,
+    )
+
+    path = ckpt.latest_array_dir(index_dir)
+    if path is None:
+        return None
+    return int(os.path.basename(path).lstrip("v"))
+
+
+def run_soak(cfg: SoakConfig | None = None, *,
+             index_dir: str | None = None) -> dict:
+    """Run one production-soak scenario and return its SLO record (also
+    published as an ``slo`` event into any active trace).  ``index_dir``
+    keeps the committed index versions when given; by default they live
+    in a temp directory deleted afterwards."""
+    cfg = cfg or SoakConfig.from_env()
+    tmp = None
+    if index_dir is None:
+        tmp = tempfile.mkdtemp(prefix="soak_idx_")
+        index_dir = tmp
+    try:
+        with obs.span("soak.run", duration_s=cfg.duration_s):
+            return _Soak(cfg, index_dir).run()
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)

@@ -44,6 +44,19 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         # parent process (bench.py) exports one id; every child run adopts
         # it in its run_start event + manifest, so trace_report --stitch
         # reassembles one trace tree for the whole round
+        "GRAFT_METRICS_PORT",  # live-metrics HTTP endpoint (obs/export.py):
+        # unset = no exporter, 0 = ephemeral port, else the literal port;
+        # serves /snapshot.json (rolling-window SLO snapshot) + /metrics
+        # (Prometheus text) from a running server/soak
+        "GRAFT_SOAK_DURATION_S",  # bench.py --soak: wall-clock length of
+        # the production-soak scenario (serving/soak.py; default 60)
+        "GRAFT_SOAK_QPS",  # bench.py --soak: closed-loop client target
+        # request rate across all client threads (default 30)
+        "GRAFT_SOAK_SLO_P99_MS",  # soak SLO target: served p99 latency
+        # bound the latency error budget is scored against (default 500)
+        "GRAFT_SOAK_SLO_AVAILABILITY",  # soak SLO target: good-request
+        # fraction the availability error budget is scored against
+        # (default 0.999)
     }
 )
 

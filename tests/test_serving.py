@@ -469,3 +469,99 @@ def test_cli_build_and_serve(tmp_path, capsys):
     assert 0 in qids  # "directed graph" matched something
     stats = json.loads(err.strip().splitlines()[-1])
     assert stats["requests"] == 3 and stats["p50_ms"] is not None
+
+
+# --------------------------------------- per-request prior ranker (ISSUE 11)
+
+
+def test_prior_ranker_per_request_blend(oracle_index):
+    """ranker='prior' blends prior_alpha * ranks for exactly the requests
+    that opt in; plain tfidf requests on the SAME server stay byte-equal
+    to the one-shot path (the zero-prior operand adds exactly nothing)."""
+    alpha = 0.5
+    n = oracle_index.n_docs
+    with serving.TfidfServer(
+        oracle_index,
+        serving.ServeConfig(top_k=n, prior_alpha=alpha, cache_size=0),
+    ) as srv:
+        qt, qw = srv.make_query(["directed", "graph"])
+        s_plain, i_plain = srv.query(["directed", "graph"])
+        s_prior, i_prior = srv.query(["directed", "graph"], ranker="prior")
+    e_scores, e_idx = _one_shot(oracle_index, qt, qw, n)
+    assert s_plain.tobytes() == e_scores.tobytes()
+    assert i_plain.tobytes() == e_idx.tobytes()
+    dense_plain = np.zeros(n, np.float32)
+    dense_plain[i_plain] = s_plain
+    dense_prior = np.zeros(n, np.float32)
+    dense_prior[i_prior] = s_prior
+    expect = dense_plain + alpha * np.asarray(oracle_index.ranks)
+    np.testing.assert_allclose(dense_prior, expect, atol=1e-6)
+
+
+def test_prior_ranker_refusal_paths(oracle_index, tmp_path):
+    # prior_alpha unset on the server: the per-request ranker refuses
+    with serving.TfidfServer(oracle_index, serving.ServeConfig()) as srv:
+        with pytest.raises(ValueError, match="prior_alpha"):
+            srv.submit(["node"], ranker="prior")
+    # an index without a ranks prior cannot host a prior-capable server
+    docs = FIXTURE.read_text().splitlines()
+    out = run_tfidf(docs, CFG)
+    serving.save_index(str(tmp_path), out, CFG)  # no ranks
+    bare = serving.load_index(str(tmp_path))
+    with pytest.raises(ValueError, match="prior"):
+        serving.TfidfServer(bare, serving.ServeConfig(prior_alpha=0.5))
+
+
+def test_set_prior_hot_swap_and_cache_invalidation(oracle_index):
+    """set_prior on a RUNNING server re-blends subsequent prior queries
+    (no recompile — operand swap) and invalidates cached results."""
+    alpha = 1.0
+    n = oracle_index.n_docs
+    with serving.TfidfServer(
+        oracle_index, serving.ServeConfig(top_k=n, prior_alpha=alpha)
+    ) as srv:
+        s1, i1 = srv.query(["node"], ranker="prior")
+        # a cache hit would return the identical object contents
+        s1b, _ = srv.query(["node"], ranker="prior")
+        assert s1.tobytes() == s1b.tobytes()
+        assert srv.stats()["cache_hits"] == 1
+        new_ranks = np.linspace(5.0, 1.0, n).astype(np.float32)
+        srv.set_prior(new_ranks)
+        s2, i2 = srv.query(["node"], ranker="prior")
+        qt, qw = srv.make_query(["node"])
+        # shape guard + not-started guard
+        with pytest.raises(ValueError, match="shape"):
+            srv.set_prior(np.ones(n + 1, np.float32))
+    base_scores, base_idx = _one_shot(oracle_index, qt, qw, n)
+    dense_base = np.zeros(n, np.float32)
+    dense_base[base_idx] = base_scores
+    dense2 = np.zeros(n, np.float32)
+    dense2[i2] = s2
+    np.testing.assert_allclose(dense2, dense_base + alpha * new_ranks,
+                               atol=1e-6)
+    # the old blend really was different (cache cleared, not replayed)
+    dense1 = np.zeros(n, np.float32)
+    dense1[i1] = s1
+    assert not np.allclose(dense1, dense2)
+
+
+def test_set_prior_requires_prior_capable_server(oracle_index):
+    with serving.TfidfServer(oracle_index, serving.ServeConfig()) as srv:
+        with pytest.raises(RuntimeError, match="prior operand"):
+            srv.set_prior(np.ones(oracle_index.n_docs, np.float32))
+
+
+def test_cache_put_rejects_stale_prior_generation(oracle_index):
+    """A batch dispatched against a pre-set_prior operand must not land
+    its result in the cache after the invalidation: _cache_put drops
+    writes whose generation predates the current prior swap."""
+    n = oracle_index.n_docs
+    with serving.TfidfServer(
+        oracle_index, serving.ServeConfig(top_k=n, prior_alpha=1.0)
+    ) as srv:
+        stale_gen = srv._prior_gen
+        srv.set_prior(np.ones(n, np.float32))  # bumps the generation
+        srv._cache_put(b"stale-key", ("x", "y"), stale_gen)
+        assert b"stale-key" not in srv._cache
+        srv._cache_put(b"fresh-key", ("x", "y"), srv._prior_gen)
+        assert b"fresh-key" in srv._cache

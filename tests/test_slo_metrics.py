@@ -1,0 +1,318 @@
+"""Live SLO telemetry (ISSUE 11): bounded-memory streaming histograms,
+rolling windows, error budgets, the bus-fed hub, and the HTTP exporter.
+
+The two satellite guarantees pinned here:
+
+- **O(bins), not O(events)**: a 10^6-event synthetic feed leaves the
+  histogram state exactly as large as after the first event — the
+  unbounded-memory risk of the old sample-retaining ``Aggregates`` is a
+  regression test now.
+- **Online-quantile accuracy**: streaming p50/p99 agree with exact numpy
+  quantiles to within one geometric bin (relative error <= growth - 1)
+  on adversarial distributions — heavy tails, bimodal spikes, constants,
+  out-of-range values.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.export import (
+    MetricsExporter,
+    metrics_port_from_env,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.metrics import (
+    ErrorBudget,
+    MetricsHub,
+    RollingHistogram,
+    StreamingHistogram,
+    TelemetrySink,
+    WindowedCounter,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        f"slo_test_{name}", REPO / "tools" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ------------------------------------------------- online quantile accuracy
+
+
+GROWTH = 1.1
+
+
+def _check_quantiles(values: np.ndarray, rel_tol: float = GROWTH - 1 + 0.03):
+    """Streaming p50/p99 within one bin (plus nearest-rank slack) of the
+    exact numpy quantiles."""
+    h = StreamingHistogram(growth=GROWTH)
+    h.observe_many(values)
+    for p in (0.50, 0.95, 0.99):
+        exact = float(np.quantile(values, p))
+        approx = h.quantile(p)
+        assert approx is not None
+        assert abs(approx - exact) <= rel_tol * exact + 1e-12, (
+            f"p{int(p * 100)}: {approx} vs exact {exact}"
+        )
+
+
+def test_quantile_accuracy_lognormal():
+    rng = np.random.default_rng(0)
+    _check_quantiles(rng.lognormal(-3.0, 1.2, 50_000))
+
+
+def test_quantile_accuracy_heavy_tail():
+    rng = np.random.default_rng(1)
+    _check_quantiles(1e-3 * (1.0 + rng.pareto(1.5, 50_000)))
+
+
+def test_quantile_accuracy_bimodal():
+    rng = np.random.default_rng(2)
+    fast = rng.normal(2e-3, 1e-4, 45_000)
+    slow = rng.normal(1.0, 5e-2, 5_000)  # the retry-spike mode
+    _check_quantiles(np.abs(np.concatenate([fast, slow])))
+
+
+def test_quantile_constant_distribution_is_exact():
+    h = StreamingHistogram(growth=GROWTH)
+    h.observe_many(np.full(10_000, 0.0421))
+    # every quantile of a constant stream is the constant, exactly
+    # (bin midpoint clamps into the exact [min, max] observed range)
+    for p in (0.01, 0.5, 0.99):
+        assert h.quantile(p) == pytest.approx(0.0421, abs=0.0)
+
+
+def test_quantile_out_of_range_values_clamp():
+    h = StreamingHistogram(lo=1e-4, hi=1e2, growth=GROWTH)
+    h.observe_many(np.array([1e-9] * 50 + [1e9] * 50))
+    assert h.quantile(0.25) == pytest.approx(1e-9)  # underflow -> exact min
+    assert h.quantile(0.99) == pytest.approx(1e9)  # overflow -> exact max
+    snap = h.snapshot()
+    assert snap["min"] == pytest.approx(1e-9)
+    assert snap["max"] == pytest.approx(1e9)
+
+
+def test_quantile_order_independence():
+    rng = np.random.default_rng(3)
+    vals = rng.lognormal(-4, 1.0, 20_000)
+    a = StreamingHistogram(growth=GROWTH)
+    b = StreamingHistogram(growth=GROWTH)
+    a.observe_many(vals)
+    b.observe_many(np.sort(vals)[::-1])  # adversarial arrival order
+    assert a.quantile(0.99) == b.quantile(0.99)
+    assert a.snapshot() == b.snapshot()
+
+
+# ------------------------------------------------------- bounded memory
+
+
+def test_histogram_memory_is_o_bins_over_1e6_events():
+    """The soak-length regression: 10^6 observations leave the histogram
+    state byte-identical in size to after the first one."""
+    rng = np.random.default_rng(4)
+    h = StreamingHistogram()
+    h.observe(0.01)
+    bytes_at_1 = h.approx_bytes()
+    h.observe_many(rng.lognormal(-3, 1.5, 1_000_000))
+    assert h.count == 1_000_001
+    assert h.approx_bytes() == bytes_at_1  # no per-event storage, ever
+    # and the state really is just the fixed bin array
+    assert h._counts.shape == (h.bins.n_slots,)
+    assert h.bins.n_slots < 1024
+
+
+def test_aggregates_histogram_bounded_and_exact_over_1e6_events():
+    """The run-end Aggregates ride the same instrument: feed 10^6 events
+    through the public histogram() path; count/sum/min/max/mean stay
+    exact, quantiles are bin-accurate, and memory does not grow."""
+    agg = obs.Aggregates()
+    rng = np.random.default_rng(5)
+    vals = rng.lognormal(-5, 1.0, 1_000_000)
+    for v in vals[:1000]:
+        agg.histogram("lat", float(v))
+    bytes_early = agg._hists["lat"].approx_bytes()
+    # the remaining ~10^6 go through the same observe() path, vectorized
+    # per-article of the instrument's own API to keep the test fast
+    agg._hists["lat"].observe_many(vals[1000:])
+    assert agg._hists["lat"].approx_bytes() == bytes_early
+    s = agg.summary()["histograms"]["lat"]
+    assert s["count"] == 1_000_000
+    assert s["min"] == pytest.approx(float(vals.min()))
+    assert s["max"] == pytest.approx(float(vals.max()))
+    assert s["mean"] == pytest.approx(float(vals.mean()), rel=1e-9)
+    assert s["p50"] == pytest.approx(float(np.quantile(vals, 0.5)), rel=0.13)
+    assert s["p99"] == pytest.approx(float(np.quantile(vals, 0.99)), rel=0.13)
+    # the legacy summary keys trace_report renders are all still there
+    assert {"count", "sum", "min", "max", "mean", "p50", "p90"} <= set(s)
+
+
+# ---------------------------------------------------- rolling windows
+
+
+def test_rolling_histogram_expires_old_slots():
+    clk = FakeClock()
+    r = RollingHistogram(window_s=10.0, slots=10, clock=clk)
+    for i in range(50):
+        clk.t = i * 0.1  # first 5 seconds: fast requests
+        r.observe(0.001)
+    clk.t = 8.0
+    for _ in range(10):  # a late slow burst
+        r.observe(1.0)
+    assert r.window_count() == 60
+    p99 = r.quantile(0.99)
+    assert p99 == pytest.approx(1.0, rel=0.15)
+    # advance past the window: the early fast mode expires, p50 is now slow
+    clk.t = 16.0
+    assert r.window_count() == 10
+    assert r.quantile(0.50) == pytest.approx(1.0, rel=0.15)
+    clk.t = 40.0
+    assert r.window_count() == 0
+    assert r.quantile(0.99) is None
+
+
+def test_windowed_counter_rate():
+    clk = FakeClock()
+    c = WindowedCounter(window_s=10.0, slots=10, clock=clk)
+    for i in range(100):
+        clk.t = i * 0.1  # 10 adds/sec for 10s
+        c.add()
+    assert c.total() == 100
+    assert c.rate() == pytest.approx(10.0, rel=0.15)
+    clk.t = 25.0  # everything expired
+    assert c.window_sum() == 0.0
+    assert c.total() == 100  # cumulative survives
+
+
+def test_error_budget_burn():
+    clk = FakeClock()
+    b = ErrorBudget(0.99, window_s=10.0, slots=10, clock=clk)
+    for i in range(1000):
+        clk.t = i * 0.01
+        b.observe(good=(i % 100) != 0)  # exactly the allowed 1% bad
+    s = b.snapshot()
+    assert s["total"] == 1000 and s["bad"] == 10
+    assert s["allowed"] == pytest.approx(10.0)
+    assert s["consumed_frac"] == pytest.approx(1.0)
+    assert s["burn_rate"] == pytest.approx(1.0, rel=0.2)
+    # a hard outage: 50 straight failures => burn explodes
+    for i in range(50):
+        clk.t = 10.0 + i * 0.01
+        b.observe(good=False)
+    assert b.snapshot()["burn_rate"] > 5.0
+
+
+# ------------------------------------------------- hub fed from the bus
+
+
+def test_bus_feeds_hub_with_zero_call_site_wiring():
+    """Attach a TelemetrySink and publish the events the serving/ingest
+    paths already emit: the hub's window quantiles, counters and budgets
+    light up with no publisher changes."""
+    hub = MetricsHub(window_s=30.0, latency_slo_s=0.25,
+                     availability_target=0.999)
+    sink = TelemetrySink(hub)
+    obs.bus().attach(sink)
+    try:
+        for i in range(40):
+            obs.emit("serve_request", cache="miss", queue_wait_s=0.002,
+                     total_s=0.010 + 0.0005 * i, batch=4)
+        obs.emit("serve_request", cache="miss", queue_wait_s=0.0,
+                 total_s=0.4, batch=1, error="ChaosError: boom")
+        obs.emit("chaos", site="serve_dispatch", fault="lost", call=7)
+        obs.emit("retry", site="serve_dispatch", attempt=1, error="x")
+        obs.emit("metric", event="chunk", chunk=0, tokens=512, secs=0.01)
+    finally:
+        obs.bus().detach(sink)
+    snap = hub.snapshot()
+    win = snap["latency_s"]["window"]
+    assert win["count"] == 40  # the error's latency is not service time
+    assert 0.01 <= win["p99"] <= 0.05
+    ctr = {k: v["total"] for k, v in snap["counters"].items()}
+    assert ctr["serve.requests"] == 41 and ctr["serve.errors"] == 1
+    assert ctr["chaos.injections"] == 1 and ctr["chaos.losses"] == 1
+    assert ctr["retry"] == 1
+    assert ctr["ingest.chunks"] == 1 and ctr["ingest.tokens"] == 512
+    avail = snap["budgets"]["availability"]
+    assert avail["bad"] == 1 and avail["total"] == 41
+    lat_budget = snap["budgets"]["latency"]
+    assert lat_budget["bad"] == 1  # the failed request also missed latency
+
+
+# ----------------------------------------------------- HTTP exporter
+
+
+def test_exporter_serves_snapshot_and_prometheus():
+    hub = MetricsHub(window_s=30.0)
+    hub.observe_request(0.017, ok=True, queue_wait_s=0.001)
+    hub.count("serve.requests")
+    hub.gauge("h2d_overlap_frac", 0.9)
+    with MetricsExporter(hub, port=0) as ex:
+        assert ex.port > 0
+        with urllib.request.urlopen(ex.url + "/snapshot.json",
+                                    timeout=5) as resp:
+            snap = json.loads(resp.read())
+        assert snap["latency_s"]["window"]["count"] == 1
+        assert snap["gauges"]["h2d_overlap_frac"] == 0.9
+        with urllib.request.urlopen(ex.url + "/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+        assert "graft_serve_latency_seconds" in text
+        assert "graft_h2d_overlap_frac 0.9" in text
+        with urllib.request.urlopen(ex.url + "/healthz", timeout=5) as resp:
+            assert resp.read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(ex.url + "/nope", timeout=5)
+
+
+def test_metrics_port_knob(monkeypatch):
+    monkeypatch.delenv("GRAFT_METRICS_PORT", raising=False)
+    assert metrics_port_from_env() is None
+    monkeypatch.setenv("GRAFT_METRICS_PORT", "0")
+    assert metrics_port_from_env() == 0
+    monkeypatch.setenv("GRAFT_METRICS_PORT", "9109")
+    assert metrics_port_from_env() == 9109
+
+
+# ----------------------------------------------------- slo_watch renderer
+
+
+def test_slo_watch_renders_live_endpoint():
+    """The terminal watcher end-to-end: fetch a live exporter's snapshot
+    and render the board (stdlib-only module, loaded from tools/)."""
+    watch = _tool("slo_watch")
+    hub = MetricsHub(window_s=30.0, latency_slo_s=0.25,
+                     availability_target=0.999)
+    for i in range(20):
+        hub.observe_request(0.004 + 0.0001 * i, ok=True)
+    hub.observe_request(0.4, ok=False)
+    with MetricsExporter(hub, port=0) as ex:
+        snap = watch.fetch(ex.url)
+    board = watch.render(snap)
+    assert "serve latency ms" in board
+    assert "p99" in board
+    assert "budget[availability]" in board
+    assert "serve.errors" in board
+    # and the CLI --once path over the same endpoint
+    with MetricsExporter(hub, port=0) as ex:
+        assert watch.main(["--url", ex.url, "--once"]) == 0

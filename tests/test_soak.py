@@ -1,0 +1,237 @@
+"""Production soak harness (ISSUE 11): SLO record, recovery after an
+injected device loss, the zero-dropped / zero-double-served invariants
+across mid-soak server rebuilds, the live endpoint agreement, and the
+trace_report / trace_diff SLO surfaces.
+
+The two short soak runs here are the acceptance scenario at test scale
+(seconds, not minutes): tools/ci.sh runs the ~20 s smoke gate and the
+bench round runs the full >= 60 s one.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.serving.soak import (
+    SoakConfig,
+    run_soak,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        f"soak_test_{name}", REPO / "tools" / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def loss_soak(tmp_path_factory):
+    """ONE traced soak with an injected device loss, shared by the
+    record/trace assertions below (a soak costs wall-clock by design)."""
+    trace_dir = tmp_path_factory.mktemp("soak_trace")
+    with obs.run("soaktest", trace_dir=str(trace_dir)) as r:
+        record = run_soak(SoakConfig(
+            duration_s=6.0, qps=20.0, clients=2,
+            rebuild_every_s=2.5, chunk_interval_s=0.3,
+            prior_refresh_every_s=2.0,
+            losses=1, loss_at_s=2.0, grace_s=20.0,
+        ))
+    return record, r.trace_path
+
+
+def test_soak_slo_record_acceptance(loss_soak):
+    """The acceptance record: served p50/p99 under ingest load, error
+    budget, a measured time-to-recover for the injected loss, and
+    dropped/double-served == 0."""
+    rec, _ = loss_soak
+    assert rec["requests"] > 40
+    assert rec["served_p50_ms"] is not None
+    assert rec["served_p99_ms"] is not None
+    assert rec["served_p99_ms"] >= rec["served_p50_ms"]
+    # the loss fired and the supervisor measurably recovered
+    assert rec["chaos_losses"] >= 1
+    recov = rec["recovery"]
+    assert recov["losses_injected"] == 1
+    assert recov["time_to_recover_s"] is not None
+    assert 0.0 < recov["time_to_recover_s"] < 20.0
+    assert recov["recoveries"][0]["reason"] == "device_loss"
+    # the invariants: every logical request served exactly once
+    assert rec["dropped"] == 0
+    assert rec["double_served"] == 0
+    # ingest ran CONCURRENTLY: chunks streamed and versions committed
+    assert rec["ingest"]["chunks"] > 0
+    assert rec["ingest"]["rebuilds"] >= 1
+    assert rec["ingest"]["index_version"] >= 2
+    # mixed traffic actually mixed
+    mixed = rec["mixed_traffic"]
+    assert sum(mixed.values()) == rec["requests"]
+    assert mixed["tfidf"] > 0 and mixed["bm25"] > 0 and mixed["prior"] > 0
+    # error budgets present with the configured targets
+    avail = rec["error_budget"]["availability"]
+    assert avail["target"] == 0.999
+    assert avail["total"] >= rec["requests"]
+    assert "burn_rate" in avail and "consumed_frac" in avail
+
+
+def test_soak_endpoint_serves_live_window(loss_soak):
+    """The live metrics endpoint answered mid-run and its p99 agrees
+    with the hub window the final record was scored from (the HTTP view
+    IS the instrument, not a parallel bookkeeping path)."""
+    rec, _ = loss_soak
+    ep = rec["endpoint"]
+    assert ep["port"] > 0
+    mid = ep["mid"]
+    assert mid is not None and "error" not in mid
+    assert mid["http_p99_ms"] is not None
+    # same instrument, same moment: the HTTP read equals the direct read
+    assert mid["http_p99_ms"] == pytest.approx(mid["direct_p99_ms"],
+                                               rel=0.25)
+    # and the mid-run window agrees with the final record's window to
+    # within run-phase drift (both read the same rolling histogram)
+    assert rec["served_p99_ms"] == pytest.approx(mid["http_p99_ms"], rel=5.0)
+
+
+def test_soak_slo_record_lands_in_trace(loss_soak):
+    """The soak publishes its record as an ``slo`` event: trace_report
+    picks it up as a first-class section and renders it."""
+    rec, trace_path = loss_soak
+    tr = _tool("trace_report")
+    rep = tr.report(trace_path)
+    assert rep["slo"] is not None
+    assert rep["slo"]["served_p99_ms"] == rec["served_p99_ms"]
+    assert rep["slo"]["dropped"] == 0
+    human = tr.render_human(rep)
+    assert "slo:" in human and "error budget" in human
+    assert "time-to-recover" in human
+
+
+def test_soak_rebuild_hot_swap_no_drop_no_double(tmp_path):
+    """The mid-soak server-rebuild invariant in isolation: aggressive
+    rebuild cadence, NO injected loss — several hot swaps under live
+    traffic must drop nothing and double-serve nothing."""
+    rec = run_soak(SoakConfig(
+        duration_s=5.0, qps=24.0, clients=2,
+        rebuild_every_s=1.5, chunk_interval_s=0.25,
+        prior_refresh_every_s=30.0,  # prior path exercised elsewhere
+        losses=0, grace_s=20.0,
+    ), index_dir=str(tmp_path))
+    assert rec["ingest"]["rebuilds"] >= 2
+    assert rec["ingest"]["index_version"] >= 3  # bootstrap + rebuilds
+    assert rec["requests"] > 40
+    assert rec["dropped"] == 0
+    assert rec["double_served"] == 0
+    assert rec["recovery"]["losses_injected"] == 0
+    assert rec["recovery"]["time_to_recover_s"] is None
+    assert rec["served_p99_ms"] is not None
+    # the record is exactly one JSON line's worth of plain data
+    json.dumps(rec)
+
+
+def test_soak_config_from_env(monkeypatch):
+    monkeypatch.setenv("GRAFT_SOAK_DURATION_S", "17")
+    monkeypatch.setenv("GRAFT_SOAK_QPS", "9")
+    monkeypatch.setenv("GRAFT_SOAK_SLO_P99_MS", "123")
+    monkeypatch.setenv("GRAFT_SOAK_SLO_AVAILABILITY", "0.99")
+    cfg = SoakConfig.from_env(clients=2)
+    assert cfg.duration_s == 17.0
+    assert cfg.qps == 9.0
+    assert cfg.slo_p99_ms == 123.0
+    assert cfg.availability_target == 0.99
+    assert cfg.clients == 2
+    monkeypatch.delenv("GRAFT_SOAK_DURATION_S")
+    assert SoakConfig.from_env().duration_s == 60.0
+
+
+# ------------------------------------------------ trace_diff SLO gate
+
+
+def _bench_record(path: Path, slo: dict | None,
+                  breakdown: dict | None = None) -> str:
+    extra: dict = {"breakdown": breakdown or {"tfidf.stream": 10.0},
+                   "breakdown_wall_secs": 12.0}
+    extra["slo"] = slo
+    path.write_text(json.dumps({
+        "metric": "pagerank_iters_per_sec_webgoogle_scale",
+        "value": 100.0, "unit": "iters/sec", "vs_baseline": 1.5,
+        "extra": extra,
+    }))
+    return str(path)
+
+
+def _slo(p99: float, consumed: float = 0.1, dropped: int = 0) -> dict:
+    return {
+        "served_p99_ms": p99,
+        "error_budget": {
+            "availability": {"target": 0.999, "consumed_frac": consumed},
+            "latency": {"target": 0.99, "consumed_frac": 0.0},
+        },
+        "dropped": dropped,
+        "double_served": 0,
+    }
+
+
+def test_trace_diff_slo_p99_regression_fails(tmp_path, capsys):
+    td = _tool("trace_diff")
+    old = _bench_record(tmp_path / "BENCH_r01.json", _slo(p99=50.0))
+    new = _bench_record(tmp_path / "BENCH_r02.json", _slo(p99=120.0))
+    rc = td.main([old, new, "--threshold", "0.35"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "slo.served_p99_ms" in out and "REGRESSED" in out
+
+
+def test_trace_diff_slo_budget_regression_fails(tmp_path):
+    td = _tool("trace_diff")
+    old = _bench_record(tmp_path / "BENCH_r01.json",
+                        _slo(p99=50.0, consumed=0.10))
+    new = _bench_record(tmp_path / "BENCH_r02.json",
+                        _slo(p99=50.0, consumed=0.80))
+    assert td.main([old, new, "--threshold", "0.35", "--json"]) == 1
+
+
+def test_trace_diff_slo_invariant_regression_fails(tmp_path):
+    td = _tool("trace_diff")
+    old = _bench_record(tmp_path / "BENCH_r01.json", _slo(p99=50.0))
+    new = _bench_record(tmp_path / "BENCH_r02.json",
+                        _slo(p99=50.0, dropped=2))
+    assert td.main([old, new, "--threshold", "0.35"]) == 1
+
+
+def test_trace_diff_slo_within_threshold_passes(tmp_path):
+    td = _tool("trace_diff")
+    old = _bench_record(tmp_path / "BENCH_r01.json", _slo(p99=50.0))
+    new = _bench_record(tmp_path / "BENCH_r02.json",
+                        _slo(p99=55.0, consumed=0.2))
+    assert td.main([old, new, "--threshold", "0.35"]) == 0
+
+
+def test_trace_diff_slo_jitter_floor(tmp_path):
+    """Single-digit-ms p99 noise on a fast CPU soak must not fail CI even
+    when it is large RELATIVELY (1ms -> 2.5ms is 2.5x but 1.5ms)."""
+    td = _tool("trace_diff")
+    old = _bench_record(tmp_path / "BENCH_r01.json", _slo(p99=1.0))
+    new = _bench_record(tmp_path / "BENCH_r02.json", _slo(p99=2.5))
+    assert td.main([old, new, "--threshold", "0.35"]) == 0
+
+
+def test_trace_diff_slo_absent_on_old_round_is_not_a_regression(tmp_path):
+    """r08 and earlier carry no SLO record: the first SLO-carrying round
+    must not fail the gate against them — but LOSING the record once the
+    trajectory has one is itself a regression."""
+    td = _tool("trace_diff")
+    old = _bench_record(tmp_path / "BENCH_r01.json", None)
+    new = _bench_record(tmp_path / "BENCH_r02.json", _slo(p99=50.0))
+    assert td.main([old, new, "--threshold", "0.35"]) == 0
+    old2 = _bench_record(tmp_path / "BENCH_r03.json", _slo(p99=50.0))
+    new2 = _bench_record(tmp_path / "BENCH_r04.json", None)
+    assert td.main([old2, new2, "--threshold", "0.35"]) == 1

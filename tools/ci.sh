@@ -115,6 +115,39 @@ print("traced-run smoke: OK "
       f"h2d_overlap {rep['ingest'][-1]['h2d_overlap_frac']})")
 EOF
 
+echo "== soak smoke (bounded SLO gate: ~${GRAFT_SOAK_DURATION_S:-20}s CPU soak under *:fail@%5 chaos) =="
+# A bounded production soak (ISSUE 11): continuous streaming ingest +
+# index rebuild/hot-swap + mixed tfidf/bm25/@prior closed-loop traffic +
+# ONE injected device loss, all under *:fail@%5 transient chaos, must
+# produce a parseable SLO record with a non-null served p99 and a
+# measured time-to-recover, and the zero-dropped / zero-double-served
+# invariants must hold.  This is the "heavy traffic" claim as a CI gate.
+if ! env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    GRAFT_CHAOS="*:fail@%5" \
+    GRAFT_SOAK_DURATION_S="${GRAFT_SOAK_DURATION_S:-20}" \
+    GRAFT_SOAK_QPS="${GRAFT_SOAK_QPS:-15}" \
+    python bench.py --soak > "$smoke_dir/soak.json" 2> "$smoke_dir/soak.log"; then
+    echo "FAIL: soak child; its stderr tail:" >&2
+    tail -30 "$smoke_dir/soak.log" >&2
+    exit 1
+fi
+python - "$smoke_dir/soak.json" <<'EOF'
+import json, sys
+rec = json.loads(open(sys.argv[1]).read().strip().splitlines()[-1])
+assert rec.get("served_p99_ms") is not None, f"null p99: {rec}"
+recov = rec.get("recovery") or {}
+assert recov.get("losses_injected", 0) >= 1, f"no loss injected: {recov}"
+assert recov.get("time_to_recover_s") is not None, f"no recovery time: {recov}"
+assert rec.get("dropped") == 0, f"dropped requests: {rec['dropped']}"
+assert rec.get("double_served") == 0, f"double-served: {rec['double_served']}"
+assert (rec.get("ingest") or {}).get("chunks", 0) > 0, "no ingest ran"
+print("soak smoke: OK "
+      f"({rec['requests']} req at {rec['qps']} qps, "
+      f"p99 {rec['served_p99_ms']}ms, "
+      f"recovered in {recov['time_to_recover_s']}s, "
+      f"{rec['ingest']['rebuilds']} rebuild(s))")
+EOF
+
 echo "== chaos gate (tier-1 under *:fail@%5 + device_lost mesh-shrink scenario) =="
 # chaos.sh's second half runs the device_lost sharded scenario under
 # XLA_FLAGS=--xla_force_host_platform_device_count=2: both sharded runners

@@ -1,0 +1,119 @@
+#!/usr/bin/env python3
+"""Terminal SLO watcher over a running process's live-metrics endpoint.
+
+Points at the stdlib HTTP exporter :mod:`obs.export` serves on
+``GRAFT_METRICS_PORT`` (``/snapshot.json``) and renders the
+rolling-window SLO board — served p50/p95/p99, request/error rates,
+error-budget consumption and burn — refreshing in place.  The live-view
+counterpart of the Spark web UI: a soak or ``cli.serve`` process is
+inspectable *while it runs*, no SIGKILL post-mortem required.
+
+Deliberately stdlib-only (same rule as trace_report.py/trace_diff.py: it
+must run from any jax-free shell).
+
+Usage::
+
+    python tools/slo_watch.py --port 9109            # loop, 2s refresh
+    python tools/slo_watch.py --port 9109 --once     # one snapshot
+    python tools/slo_watch.py --url http://host:9109 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any
+
+
+def fetch(url: str, timeout: float = 5.0) -> dict[str, Any]:
+    with urllib.request.urlopen(url.rstrip("/") + "/snapshot.json",
+                                timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _ms(v: Any) -> str:
+    return "      -" if v is None else f"{v * 1e3:7.2f}"
+
+
+def render(snap: dict[str, Any]) -> str:
+    """One snapshot as a fixed-width terminal board (pure function — unit
+    tested without a server)."""
+    lines: list[str] = []
+    win = snap.get("latency_s", {}).get("window", {}) or {}
+    tot = snap.get("latency_s", {}).get("total", {}) or {}
+    qw = snap.get("queue_wait_s", {}) or {}
+    lines.append(
+        f"serve latency ms  (rolling {snap.get('window_s', '?')}s window, "
+        f"{win.get('count', 0)} requests in window)"
+    )
+    lines.append(
+        f"  p50 {_ms(win.get('p50'))}   p90 {_ms(win.get('p90'))}   "
+        f"p95 {_ms(win.get('p95'))}   p99 {_ms(win.get('p99'))}"
+    )
+    lines.append(
+        f"  cumulative: {tot.get('count', 0)} served, "
+        f"mean {_ms(tot.get('mean'))}ms, p99 {_ms(tot.get('p99'))}ms; "
+        f"queue-wait p99 {_ms(qw.get('p99'))}ms"
+    )
+    budgets = snap.get("budgets", {}) or {}
+    for name, b in sorted(budgets.items()):
+        lines.append(
+            f"budget[{name}]: target {b.get('target')}  bad "
+            f"{b.get('bad')}/{b.get('total')}  consumed "
+            f"{b.get('consumed_frac')}x allowed  burn {b.get('burn_rate')}x"
+        )
+    counters = snap.get("counters", {}) or {}
+    if counters:
+        lines.append("counters (total | /s over window):")
+        for name, c in sorted(counters.items()):
+            lines.append(
+                f"  {name:24s} {c.get('total', 0):12.0f} | "
+                f"{c.get('rate_per_s', 0.0):8.2f}/s"
+            )
+    gauges = snap.get("gauges", {}) or {}
+    for name, v in sorted(gauges.items()):
+        lines.append(f"gauge {name} = {v}")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="slo_watch", description=__doc__)
+    ap.add_argument("--url", default=None,
+                    help="endpoint base url (overrides --host/--port)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9109,
+                    help="the process's GRAFT_METRICS_PORT")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="raw snapshot JSON instead of the board")
+    args = ap.parse_args(argv)
+    url = args.url or f"http://{args.host}:{args.port}"
+
+    while True:
+        try:
+            snap = fetch(url)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as exc:
+            print(f"slo_watch: {url}: {exc}", file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(snap, indent=2))
+        else:
+            if not args.once:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear, home
+            print(f"slo_watch {url}  "
+                  f"@ {time.strftime('%H:%M:%S')}")
+            print(render(snap))
+            sys.stdout.flush()
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

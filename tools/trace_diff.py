@@ -1,4 +1,5 @@
-"""Per-phase wall-time regression attribution between two bench rounds.
+"""Per-phase wall-time + SLO regression attribution between two bench
+rounds.
 
 Takes two BENCH artifacts — either round records (``BENCH_rNN.json``, whose
 ``extra.breakdown`` the bench parent derives from the tfidf child's trace)
@@ -7,6 +8,16 @@ tools/trace_report.py) — and answers the question a slower round always
 raises: *which phase* paid for it.  This is the comparison layer over the
 per-phase breakdowns the obs/ subsystem already records; nothing is
 re-measured.
+
+Since ISSUE 11 the diff also regresses the **SLO record** the soak
+harness emits (``extra.slo`` on a BENCH round; the ``slo`` event on a raw
+trace): a new round whose served p99 grew past ``--threshold`` relative
+to the old one, or whose error-budget consumption worsened past the same
+threshold (absolute fraction), fails the diff exactly like a phase
+regression — production SLOs are part of the committed trajectory, not a
+side channel.  Rounds are only compared when BOTH carry an SLO record,
+except that a new round *losing* its record while the old one had one is
+itself flagged (the bench lost its SLO accounting).
 
 Stdlib-only (importable from the jax-free bench parent, same rule as
 trace_report.py).
@@ -17,8 +28,8 @@ Usage::
     python tools/trace_diff.py old/tfidf.123.trace.jsonl new/tfidf.456.trace.jsonl
     python tools/trace_diff.py A B --json [--threshold 0.10]
 
-Exit codes: 0 = no phase regressed past --threshold, 1 = at least one did,
-2 = artifacts unreadable/incomparable.
+Exit codes: 0 = no phase or SLO regressed past --threshold, 1 = at least
+one did, 2 = artifacts unreadable/incomparable.
 """
 
 from __future__ import annotations
@@ -30,13 +41,20 @@ import os
 import sys
 
 
+_trace_report_mod = None
+
+
 def _trace_report():
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "trace_report.py")
-    spec = importlib.util.spec_from_file_location("trace_diff_report", path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    global _trace_report_mod
+    if _trace_report_mod is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "trace_report.py")
+        spec = importlib.util.spec_from_file_location(
+            "trace_diff_report", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _trace_report_mod = mod
+    return _trace_report_mod
 
 
 def load_breakdown(path: str) -> tuple[dict[str, float], float | None, str]:
@@ -82,6 +100,82 @@ def _fold_overlapped(bd: dict[str, float]) -> dict[str, float]:
         key = _OVERLAPPED_FOLD.get(phase, phase)
         out[key] = out.get(key, 0.0) + secs
     return out
+
+
+def load_slo(path: str) -> dict | None:
+    """The SLO record riding an artifact: ``extra.slo`` for a BENCH round
+    record, the trace's ``slo`` event for a raw JSONL trace; None when
+    the artifact carries none (pre-ISSUE-11 rounds)."""
+    if path.endswith(".jsonl"):
+        return _trace_report().report(path).get("slo")
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    slo = record.get("extra", {}).get("slo")
+    return slo if isinstance(slo, dict) else None
+
+
+# Minimum absolute p99 delta (ms) an SLO regression must also clear — a
+# CPU-backend soak's p99 jitters by single-digit milliseconds run to run.
+SLO_MIN_DELTA_MS = 2.0
+
+
+def diff_slo(
+    old: dict | None, new: dict | None, threshold: float
+) -> list[dict]:
+    """SLO regression rows (empty = fine).  p99 regresses RELATIVELY
+    (new > old * (1 + threshold), past a small absolute floor); budget
+    consumption regresses ABSOLUTELY (consumed_frac grew by more than
+    ``threshold`` of the budget).  A vanished record regresses; a newly
+    appearing one never does."""
+    if old is None:
+        return []
+    if new is None:
+        return [{
+            "key": "slo.missing",
+            "old": "present",
+            "new": None,
+            "why": "the old round carried an SLO record and the new one "
+                   "does not — the round lost its SLO accounting",
+        }]
+    rows: list[dict] = []
+    o_p99, n_p99 = old.get("served_p99_ms"), new.get("served_p99_ms")
+    if o_p99 is not None and n_p99 is not None:
+        if n_p99 > o_p99 * (1.0 + threshold) and n_p99 - o_p99 > SLO_MIN_DELTA_MS:
+            rows.append({
+                "key": "slo.served_p99_ms",
+                "old": o_p99,
+                "new": n_p99,
+                "why": f"served p99 grew {n_p99 / max(o_p99, 1e-9):.2f}x",
+            })
+    for name in ("availability", "latency"):
+        o_b = (old.get("error_budget") or {}).get(name) or {}
+        n_b = (new.get("error_budget") or {}).get(name) or {}
+        o_c, n_c = o_b.get("consumed_frac"), n_b.get("consumed_frac")
+        if o_c is None or n_c is None:
+            continue
+        if n_c - o_c > threshold:
+            rows.append({
+                "key": f"slo.budget.{name}",
+                "old": o_c,
+                "new": n_c,
+                "why": (f"{name} error-budget consumption grew "
+                        f"{n_c - o_c:+.3f} (absolute)"),
+            })
+    for key in ("dropped", "double_served"):
+        o_v, n_v = old.get(key), new.get(key)
+        if isinstance(o_v, int) and isinstance(n_v, int) and n_v > o_v:
+            rows.append({
+                "key": f"slo.{key}",
+                "old": o_v,
+                "new": n_v,
+                "why": f"{key} requests appeared — an invariant, not a knob",
+            })
+    return rows
 
 
 def diff_breakdowns(
@@ -135,12 +229,22 @@ def main(argv: list[str] | None = None) -> int:
         if r["delta_secs"] > args.min_secs
         and (r["delta_frac"] is None or r["delta_frac"] > args.threshold)
     ]
+    # No try/except here: load_slo already returns None for an artifact
+    # without a record, and a trace unreadable at this point would have
+    # failed load_breakdown above — a surviving error is a real bug that
+    # must not silently pass the SLO gate.
+    slo_rows = diff_slo(load_slo(args.old), load_slo(args.new),
+                        args.threshold)
+    all_regressions = (
+        [r["phase"] for r in regressions] + [r["key"] for r in slo_rows]
+    )
     result = {
         "old": {"path": args.old, "kind": old_kind, "wall_secs": old_wall},
         "new": {"path": args.new, "kind": new_kind, "wall_secs": new_wall},
         "phases": rows,
-        "regressions": [r["phase"] for r in regressions],
-        "worst_regression": regressions[0]["phase"] if regressions else None,
+        "slo": slo_rows,
+        "regressions": all_regressions,
+        "worst_regression": all_regressions[0] if all_regressions else None,
     }
 
     if args.json:
@@ -158,12 +262,16 @@ def main(argv: list[str] | None = None) -> int:
             mark = " <-- REGRESSED" if r["phase"] in result["regressions"] else ""
             print(f"{r['phase']:28s} {r['old_secs']:9.3f} {r['new_secs']:9.3f} "
                   f"{r['delta_secs']:+9.3f}  {rel}{mark}")
-        if regressions:
-            print(f"trace_diff: {len(regressions)} phase(s) regressed past "
+        for r in slo_rows:
+            print(f"{r['key']:28s} {r['old']!s:>9s} {r['new']!s:>9s}  "
+                  f"{r['why']} <-- REGRESSED")
+        if all_regressions:
+            print(f"trace_diff: {len(all_regressions)} regression(s) past "
                   f"+{args.threshold:.0%}; worst: {result['worst_regression']}")
         else:
-            print("trace_diff: no phase regressed past the threshold")
-    return 1 if regressions else 0
+            print("trace_diff: no phase regressed past the threshold "
+                  "(SLO clean)")
+    return 1 if all_regressions else 0
 
 
 if __name__ == "__main__":

@@ -262,6 +262,18 @@ def report(path: str) -> dict[str, Any]:
         if e["kind"] == "ingest_overlap"
     ]
 
+    # SLO record (ISSUE 11): the soak harness publishes ONE ``slo`` event
+    # at scoring time — served p50/p99 under ingest load, error-budget
+    # burn, time-to-recover, dropped/double-served.  The last one wins (a
+    # trace normally holds exactly one).
+    slo_events = [
+        {k: v for k, v in e.items()
+         if k not in ("kind", "t", "wall", "thread", "seq")}
+        for e in events
+        if e["kind"] == "slo"
+    ]
+    slo = slo_events[-1] if slo_events else None
+
     # Serving-path accounting (ISSUE 8): per-request ``serve_request``
     # events carry queue-wait and total latency; the serve.pad/dispatch/
     # pull spans give the phase split.  Present only for serve runs.
@@ -302,6 +314,7 @@ def report(path: str) -> dict[str, Any]:
             or (manifest or {}).get("trace_parent")
         ),
         "serving": serving,
+        "slo": slo,
         "events": len(events),
         "bad_lines": bad,
         "complete": run_end is not None,
@@ -397,6 +410,7 @@ def stitch(root: str) -> dict[str, Any]:
             "wall_secs": round(rep["wall_secs"], 3),
             "breakdown": {k: round(v, 3) for k, v in rep["breakdown"].items()},
             "serving": rep.get("serving"),
+            "slo": rep.get("slo"),
         })
         tree["wall_secs"] = round(tree["wall_secs"] + rep["wall_secs"], 3)
         tree["retries"] += sum(rep["retries"].values())
@@ -499,6 +513,33 @@ def render_human(rep: dict[str, Any]) -> str:
             lines.append("  " + ", ".join(
                 f"{k} {v:.3f}s" for k, v in sv["phases"].items()
             ))
+    if rep.get("slo"):
+        slo = rep["slo"]
+        rec = slo.get("recovery") or {}
+        budgets = slo.get("error_budget") or {}
+        avail = budgets.get("availability") or {}
+        lines.append(
+            f"slo: {slo.get('requests')} requests at "
+            f"{slo.get('qps')} qps over {slo.get('duration_s')}s — "
+            f"served p50 {slo.get('served_p50_ms')}ms / "
+            f"p99 {slo.get('served_p99_ms')}ms "
+            f"(target {((slo.get('slo_targets') or {}).get('p99_ms'))}ms)"
+        )
+        lines.append(
+            f"  error budget: {avail.get('bad', 0)} bad of "
+            f"{avail.get('total', 0)} (consumed "
+            f"{avail.get('consumed_frac')}x allowed, burn "
+            f"{avail.get('burn_rate')}); dropped "
+            f"{slo.get('dropped')}, double-served "
+            f"{slo.get('double_served')}"
+        )
+        lines.append(
+            f"  losses: {rec.get('losses_injected', 0)} injected, "
+            f"time-to-recover "
+            f"{rec.get('time_to_recover_s')}s; ingest "
+            f"{((slo.get('ingest') or {}).get('chunks'))} chunks / "
+            f"{((slo.get('ingest') or {}).get('rebuilds'))} rebuilds"
+        )
     for key in ("retries", "chaos", "watchdog", "degraded", "exhausted",
                 "shrinks"):
         if rep.get(key):
