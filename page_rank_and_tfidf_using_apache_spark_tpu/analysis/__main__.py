@@ -3,9 +3,9 @@
 Usage::
 
     python -m page_rank_and_tfidf_using_apache_spark_tpu.analysis \
-        [paths...] [--tier 1|2|3|all] [--changed-only [BASE]] [--json] \
+        [paths...] [--tier 1|2|3|4|all] [--changed-only [BASE]] [--json] \
         [--baseline FILE | --no-baseline] [--write-baseline] \
-        [--cost-report] [--list-rules] [--list-entry-points]
+        [--cost-report] [--lock-graph] [--list-rules] [--list-entry-points]
 
 Tier 1 is the lexical AST rule set (stdlib-only; runs even when jax is
 broken).  Tier 2 traces the registered jit entry points on the CPU backend
@@ -13,15 +13,23 @@ and checks jaxpr-level invariants (recompile/promotion/transfer/sharding).
 Tier 3 is the static cost model over the same traces: FLOP/byte
 arithmetic-intensity floors (advisory while xla_cost_tpu.json is not
 TPU-measured), static pad_frac budgets over the partition/padding plans,
-and the buffer-donation verifier against the lowered aliasing.  Tiers 2
-and 3 need an importable jax.  All tiers report through the same ratchet
+and the buffer-donation verifier against the lowered aliasing.  Tier 4 is
+the interprocedural concurrency & buffer-lifetime analyzer (stdlib-only
+like tier 1): lock-order cycles, blocking calls under locks,
+use-after-donate dataflow against the registry's donation-liveness
+contract, chaos-coverage drift over the guarded sites, and thread/lock
+drift against utils/config.py THREAD_REGISTRY; ``--lock-graph`` emits its
+lock-acquisition graph as DOT (JSON under ``--json``).  Tiers 2 and 3
+need an importable jax.  All tiers report through the same ratchet
 baseline; tier-3 advisories are printed but never gate.
 
-With no paths, tier 1 scans the tier-1 surface (the package, ``tools/``
+With no paths, tiers 1/4 scan the tier-1 surface (the package, ``tools/``
 and ``bench.py``) and tiers 2/3 cover every registered entry point.  With
-explicit paths (or ``--changed-only``), tier 1 scans those files and tiers
-2/3 run only the entries whose contracted module is among them — unless an
-``analysis/`` file itself changed, which re-verifies every contract.
+explicit paths (or ``--changed-only``), tier 1 scans those files, tiers
+2/3 run only the entries whose contracted module is among them, and tier 4
+still models the whole surface but reports only findings in those files —
+unless an ``analysis/`` file itself changed, which re-verifies every
+contract.
 
 Exit codes: 0 = no findings beyond the ratchet baseline, 1 = new findings
 (printed), 2 = bad invocation.
@@ -55,13 +63,20 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to scan (default: package + tools + bench.py)")
-    ap.add_argument("--tier", choices=("1", "2", "3", "all"), default="all",
+    ap.add_argument("--tier", choices=("1", "2", "3", "4", "all"),
+                    default="all",
                     help="1 = lexical rules, 2 = semantic (jaxpr) checks, "
                          "3 = static cost model (intensity/pad_frac/"
-                         "donation), all = every tier (default)")
+                         "donation), 4 = interprocedural concurrency & "
+                         "buffer-lifetime analysis, all = every tier "
+                         "(default)")
     ap.add_argument("--cost-report", action="store_true",
                     help="print the tier-3 per-entry cost table as JSON "
                          "(implies the tier-3 analysis ran)")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="emit the tier-4 lock-acquisition graph as DOT "
+                         "(embedded as JSON under --json); implies the "
+                         "tier-4 analysis ran")
     ap.add_argument("--changed-only", nargs="?", const="HEAD", default=None,
                     metavar="BASE",
                     help="lint only files changed vs BASE (default HEAD): "
@@ -82,6 +97,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.list_rules:
         for rule in RULES.values():
             print(f"{rule.id:22s} [tier 1] {rule.summary}")
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis.concurrency import (
+            CONC_RULES,
+        )
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.cost import (
             COST_RULES,
         )
@@ -93,6 +111,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{rid:22s} [tier 2] {summary}")
         for rid, summary in COST_RULES.items():
             print(f"{rid:22s} [tier 3] {summary}")
+        for rid, summary in CONC_RULES.items():
+            print(f"{rid:22s} [tier 4] {summary}")
         return 0
 
     if args.list_entry_points:
@@ -113,6 +133,7 @@ def main(argv: list[str] | None = None) -> int:
     tier1 = args.tier in ("1", "all")
     tier2 = args.tier in ("2", "all")
     tier3 = args.tier in ("3", "all") or args.cost_report
+    tier4 = args.tier in ("4", "all") or args.lock_graph
 
     if args.changed_only is not None and args.paths:
         print("graftlint: give either paths or --changed-only, not both",
@@ -206,6 +227,21 @@ def main(argv: list[str] | None = None) -> int:
         advisories = cres.advisories
         cost_report = cres.report
 
+    lock_graph = None
+    if tier4:
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis import (
+            concurrency,
+        )
+
+        # interprocedural: always model the full surface; a restricted run
+        # only filters which files may report findings
+        cc = concurrency.run_concurrency(root=root, only_modules=only_modules)
+        if cc.findings:
+            findings = engine.assign_fingerprints(
+                list(findings) + cc.findings
+            )
+        lock_graph = cc.graph
+
     if tier2 or tier3:
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.registry import (
             ENTRY_POINTS,
@@ -243,12 +279,17 @@ def main(argv: list[str] | None = None) -> int:
 
         print(_json.dumps(cost_report, indent=2))
 
+    if args.lock_graph and lock_graph is not None and not args.json:
+        print(lock_graph.to_dot())
+
     if args.json:
         extra_json = {}
         if advisories:
             extra_json["advisories"] = [f.to_dict() for f in advisories]
         if args.cost_report and cost_report is not None:
             extra_json["cost_report"] = cost_report
+        if args.lock_graph and lock_graph is not None:
+            extra_json["lock_graph"] = lock_graph.to_json()
         print(
             render_json(
                 result.new,

@@ -97,6 +97,42 @@ class EntryPoint:
 
 _PKG = "page_rank_and_tfidf_using_apache_spark_tpu"
 
+# ---------------------------------------------------------------------------
+# Donation-liveness contract (tier 4, ISSUE 12).
+#
+# ``EntryPoint.donate`` tells the tier-3 verifier which buffers the LOWERED
+# computation must alias; this literal tells the tier-4 *lexical* analyzer
+# which call-site spellings consume a donated buffer, so `use-after-donate`
+# can dataflow-track the operand a caller passes at a donated position and
+# flag any later host-side read (or re-dispatch) of that binding — the
+# hazard models/pagerank.py dodges by hand at ``pagerank_delta_sync``.
+#
+# Each row is ``(callee leaf name as it appears at call sites, donated
+# positional argnums, the registry entry names the convention serves)``:
+# ``chunk_counts_carry`` is the streaming DF carry kernel called by name;
+# ``runner`` is the conventional binding every fixpoint driver gives the
+# compiled ``make_*_runner`` product (models/pagerank.py, dataflow/
+# fixpoint.py's ``call`` closures), whose carry rides at argnum 1.
+#
+# The tier-4 analyzer validates this contract against ENTRY_POINTS in both
+# directions (every donating entry must be served by a row; every row must
+# name real donating entries with matching argnums), so the lexical surface
+# and the lowered-aliasing surface cannot drift apart.  Parsed lexically —
+# keep it a literal.
+DONATED_CALLEES: tuple = (
+    ("chunk_counts_carry", (3,), ("tfidf_chunk_ingest_carry",)),
+    ("runner", (1,), (
+        "pagerank_step",
+        "pagerank_step_tol_cumsum",
+        "pagerank_step_pallas",
+        "pagerank_step_hybrid",
+        "pagerank_step_sort_shuffle",
+        "dataflow_ppr_batch",
+        "dataflow_hits",
+        "dataflow_components",
+    )),
+)
+
 # ``--tier all`` runs two analyzers (semantic + cost) over the same
 # registry in one process; building an entry — graph synthesis, mesh
 # construction, partitioning per shrink-chain device count — is the

@@ -892,6 +892,214 @@ def check_unsynced_thread_state(ctx: FileContext) -> Iterator[Hit]:
 
 
 # --------------------------------------------------------------------------
+# 8b. thread-registry-drift
+# --------------------------------------------------------------------------
+
+# The declared thread inventory (utils/config.py THREAD_REGISTRY): rows of
+# (name glob, owning module, locks it may hold).  This rule is the
+# name-side validation companion of ``unsynced-thread-state`` — the same
+# Thread-construction surface, checked against the declaration both
+# directions; the locks-held direction lives in the tier-4 concurrency
+# analyzer (``thread-lock-drift``), which shares these helpers.
+
+_thread_registry_cache: dict = {}
+
+
+def _parse_declared_rows(cfg_path, name: str) -> "tuple | None":
+    """Lexically extract a tuple-of-tuples literal assigned to ``name``:
+    each row becomes a tuple whose string elements are kept as-is and
+    whose nested tuple/list elements become tuples of their string
+    constants.  None when the file has no declaration."""
+    try:
+        tree = ast.parse(cfg_path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name for t in targets):
+            continue
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            return None
+        rows = []
+        for row in value.elts:
+            if not isinstance(row, (ast.Tuple, ast.List)):
+                continue
+            fields: list = []
+            for elt in row.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    fields.append(elt.value)
+                elif isinstance(elt, (ast.Tuple, ast.List)):
+                    fields.append(tuple(
+                        e.value for e in elt.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    ))
+            rows.append(tuple(fields))
+        return tuple(rows)
+    return None
+
+
+def thread_registry_rows(root) -> "tuple | None":
+    """THREAD_REGISTRY rows for the scanned tree (falling back to this
+    package's own utils/config.py for bare snippet lints); cached per
+    root.  Each row is ``(name_glob, module, locks)``."""
+    from pathlib import Path
+
+    key = str(root) if root is not None else ""
+    if key in _thread_registry_cache:
+        return _thread_registry_cache[key]
+    candidates = []
+    if root is not None:
+        candidates += [
+            Path(root) / "page_rank_and_tfidf_using_apache_spark_tpu/utils/config.py",
+            Path(root) / "utils/config.py",
+        ]
+    candidates.append(Path(__file__).resolve().parents[1] / "utils" / "config.py")
+    rows = None
+    for c in candidates:
+        if c.exists():
+            rows = _parse_declared_rows(c, "THREAD_REGISTRY")
+            if rows is not None:
+                break
+    _thread_registry_cache[key] = rows
+    return rows
+
+
+def resolve_thread_name(ctx: FileContext, expr: ast.AST | None,
+                        node: ast.AST) -> str | None:
+    """Static thread-name resolution: a string literal resolves to itself,
+    an f-string to a glob (formatted fields become ``*``), and a bare name
+    to the enclosing function parameter's string default.  None = the name
+    is not statically resolvable (or absent)."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.JoinedStr):
+        parts = []
+        for v in expr.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        glob = "".join(parts)
+        return glob if glob.strip("*") else None
+    if isinstance(expr, ast.Name):
+        fn = ctx.enclosing_function(node)
+        if fn is None or isinstance(fn, ast.Lambda):
+            return None
+        a = fn.args
+        params = a.posonlyargs + a.args
+        for p, d in zip(params[len(params) - len(a.defaults):], a.defaults):
+            if p.arg == expr.id and isinstance(d, ast.Constant) \
+                    and isinstance(d.value, str):
+                return d.value
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if p.arg == expr.id and isinstance(d, ast.Constant) \
+                    and isinstance(d.value, str):
+                return d.value
+    return None
+
+
+def _names_match(resolved: str, declared: str) -> bool:
+    import fnmatch
+
+    return resolved == declared or fnmatch.fnmatch(resolved, declared)
+
+
+@rule(
+    "thread-registry-drift",
+    "threading.Thread constructed with a name not declared in "
+    "utils/config.py THREAD_REGISTRY (or with no statically-resolvable "
+    "name at all), or a declared thread no code implements — the one-"
+    "process runtime's thread inventory must stay a checked declaration, "
+    "not reviewer folklore",
+)
+def check_thread_registry_drift(ctx: FileContext) -> Iterator[Hit]:
+    rows = thread_registry_rows(ctx.root)
+    if ctx.relpath.endswith("utils/config.py"):
+        # declaration side (the ladder-rung-drift convention): every
+        # declared thread must be implemented — its name's literal prefix
+        # must appear in the declared module's source.
+        if rows is None or ctx.root is None:
+            return
+        for row in rows:
+            if len(row) < 2:
+                continue
+            name, module = row[0], row[1]
+            path = ctx.root / module
+            prefix = name.split("*", 1)[0]
+            try:
+                implemented = path.exists() and (
+                    not prefix or prefix in path.read_text(encoding="utf-8")
+                )
+            except OSError:
+                implemented = False
+            if not implemented:
+                yield (
+                    ctx.tree,
+                    f"declared thread {name!r} is implemented nowhere in "
+                    f"{module} — construct the thread there (literal "
+                    "name) or drop the THREAD_REGISTRY row",
+                )
+        return
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node) not in ("threading.Thread", "Thread"):
+            continue
+        name_expr = next(
+            (kw.value for kw in node.keywords if kw.arg == "name"), None
+        )
+        if name_expr is None:
+            yield (
+                node,
+                "threading.Thread constructed without a name= — give the "
+                "thread a literal name and declare it in utils/config.py "
+                "THREAD_REGISTRY (name, owning module, locks it may hold)",
+            )
+            continue
+        resolved = resolve_thread_name(ctx, name_expr, node)
+        if resolved is None:
+            yield (
+                node,
+                "thread name is not statically resolvable — use a string "
+                "literal or f-string name so THREAD_REGISTRY can be "
+                "validated against it",
+            )
+            continue
+        if rows is None:
+            yield (
+                node,
+                f"thread {resolved!r} but no THREAD_REGISTRY declaration "
+                "found — declare the thread inventory in utils/config.py",
+            )
+            continue
+        matched = [r for r in rows if len(r) >= 2 and _names_match(resolved, r[0])]
+        if not matched:
+            yield (
+                node,
+                f"thread {resolved!r} is not declared in utils/config.py "
+                "THREAD_REGISTRY — register (name, owning module, locks it "
+                "may hold) before spawning it",
+            )
+        elif not any(r[1] == ctx.relpath for r in matched):
+            yield (
+                node,
+                f"thread {resolved!r} is declared for module "
+                f"{matched[0][1]!r} but constructed in {ctx.relpath!r} — "
+                "move the construction or fix the THREAD_REGISTRY row",
+            )
+
+
+# --------------------------------------------------------------------------
 # 9. env-knob-drift
 # --------------------------------------------------------------------------
 

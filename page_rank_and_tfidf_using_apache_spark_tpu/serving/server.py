@@ -477,7 +477,7 @@ class TfidfServer:
             # silently dropped with a hanging future
             if not self._started:
                 raise RuntimeError("server not started")
-            self._queue.put(pending)
+            self._queue.put(pending)  # graftlint: disable=blocking-under-lock (deliberate: backpressure belongs inside the started-check; the drain consumes without ever taking _submit_lock, so a blocked put always unblocks — see the _submit_lock comment above)
         with self._lock:
             self._stats["requests"] += 1
             # per-ranker traffic split for the A/B read-out — counted at

@@ -448,10 +448,34 @@ class _Soak:
             self._mid_error = {"at_s": round(now_s, 3),
                                "error": f"{type(exc).__name__}: {exc}"[:160]}
             return
+        # The HTTP snapshot was computed BETWEEN two direct reads of the
+        # same hub.  The rolling window can legitimately move inside that
+        # bracket — a retried request (one deterministic ~50–75 ms
+        # backoff) completing mid-fetch, or a ring slot expiring — and at
+        # window counts where p99 is effectively the max, one such sample
+        # flips the quantile.  Comparing the HTTP read against only the
+        # PRE-fetch direct read then manufactures a phantom disagreement
+        # between two different moments of one instrument.  Bracket it:
+        # re-read after the fetch; while the window is still swinging (and
+        # there is run left) retry on a later tick, else record the
+        # bracket endpoint closest in time-content to the HTTP read.
+        direct2 = self.hub.snapshot()
+        d1 = direct["latency_s"]["window"]["p99"]
+        d2 = direct2["latency_s"]["window"]["p99"]
+        hp = http["latency_s"]["window"]["p99"]
+        if (d1 is not None and d2 is not None and d1 != d2
+                and now_s < 0.8 * self.cfg.duration_s
+                and abs(d1 - d2) > 0.2 * max(d1, d2)):
+            return  # window moved mid-measurement; try again shortly
+        dbest = d1
+        if hp is not None and d2 is not None and (
+            d1 is None or abs(d2 - hp) <= abs(d1 - hp)
+        ):
+            dbest = d2
         self._mid = {
             "at_s": round(now_s, 3),
-            "http_p99_ms": _ms(http["latency_s"]["window"]["p99"]),
-            "direct_p99_ms": _ms(direct["latency_s"]["window"]["p99"]),
+            "http_p99_ms": _ms(hp),
+            "direct_p99_ms": _ms(dbest),
             "window_count": http["latency_s"]["window"]["count"],
         }
 

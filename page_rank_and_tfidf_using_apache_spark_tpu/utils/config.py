@@ -34,6 +34,11 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         "GRAFT_CKPT_KEEP",  # checkpoint retention count
         "GRAFT_SEMANTIC_BUDGET_S",  # tools/ci.sh wall-clock budget for the
         # semantic lint tier (read in bash, declared here all the same)
+        "GRAFT_COST_BUDGET_S",  # tools/ci.sh wall-clock budget for the
+        # tier-3 cost-model lint (read in bash; default 10s) — was read
+        # undeclared until the tier-4 sweep caught the drift
+        "GRAFT_CONC_BUDGET_S",  # tools/ci.sh wall-clock budget for the
+        # tier-4 concurrency lint (read in bash; default 10s)
         "GRAFT_TRACE_DIFF_THRESHOLD",  # tools/ci.sh per-phase wall-time
         # regression threshold for the trace-diff gate over the two newest
         # committed BENCH rounds (read in bash; default 0.35)
@@ -75,6 +80,58 @@ DEGRADE_LADDER: tuple = (
     "mesh_shrink",  # rebuild the mesh over surviving devices (pow2 shrink)
     "single_device",  # the 1-device end of the shrink chain
     "cpu",  # re-lower on the CPU backend (single-chip paths)
+)
+
+
+# Every long-lived thread the package spawns, declared in one place like
+# the env knobs and ladder rungs above — the Spark counterpart is process
+# isolation (executors, driver, block manager are separate JVMs); this
+# one-process runtime gets a declared thread inventory instead.  Each entry
+# is ``(name, owning module, locks it may hold)``:
+#
+# - ``name`` matches the literal ``threading.Thread(name=...)`` spelling;
+#   a trailing ``*`` globs a formatted suffix (``soak-client-{i}``).
+# - ``module`` is the repo-relative file that constructs the thread.
+# - the lock tuple lists every lock the thread's target (plus same-file
+#   callees) may acquire, spelled ``Class.attr`` / ``name`` (scoped to the
+#   owning module) or fully qualified ``<module>::<Class>.<attr>``.
+#
+# graftlint validates both directions: tier 1's ``thread-registry-drift``
+# fails on any Thread constructed with an undeclared (or statically
+# unresolvable) name and on declared entries no code implements, and the
+# tier-4 concurrency analyzer (``thread-lock-drift``) fails when a declared
+# thread's target acquires a lock outside its declared set — so a new
+# thread (or a new lock on an old thread) cannot land undocumented.
+# Parsed lexically by the linter — keep it a literal.
+THREAD_REGISTRY: tuple = (
+    ("ingest-source",
+     "page_rank_and_tfidf_using_apache_spark_tpu/dataflow/ingest.py",
+     ()),  # Prefetched tokenize producer: lock-free bounded queue handoff
+    ("ingest-h2d",
+     "page_rank_and_tfidf_using_apache_spark_tpu/dataflow/ingest.py",
+     ()),  # Prefetched H2D staging producer: same queue discipline
+    ("resilience-*",
+     "page_rank_and_tfidf_using_apache_spark_tpu/resilience/executor.py",
+     ()),  # per-site watchdog attempt threads: run the guarded fn only
+    ("graft-metrics-http",
+     "page_rank_and_tfidf_using_apache_spark_tpu/obs/export.py",
+     # handler threads read through the hub's own instrument locks
+     ("page_rank_and_tfidf_using_apache_spark_tpu/obs/metrics.py::"
+      "MetricsHub._lock",)),
+    ("tfidf-serve-drain",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/server.py",
+     # cache + stats; NEVER _submit_lock (the drain must keep consuming
+     # while a submitter blocks on the bounded queue holding it)
+     ("TfidfServer._lock",)),
+    ("soak-ingest",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/soak.py",
+     ("_Soak._lock",)),
+    ("soak-prior",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/soak.py",
+     ("_Soak._lock",)),
+    ("soak-client-*",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/soak.py",
+     ("_Soak._lock",)),
 )
 
 

@@ -835,6 +835,7 @@ def test_every_rule_has_summary():
         "unguarded-host-sync",
         "untraced-guarded-site",
         "unsynced-thread-state",
+        "thread-registry-drift",
         "env-knob-drift",
         "ladder-rung-drift",
         "sync-put-in-ingest-loop",

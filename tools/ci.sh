@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# Full CI pipeline: tier-1 tests, both graftlint tiers, and the chaos gate.
+# Full CI pipeline: tier-1 tests, all four graftlint tiers, and the chaos
+# gate.
 #
 # The semantic lint tier (tier 2: CPU-only jaxpr tracing of every
 # registered jit entry point) carries a wall-clock budget —
@@ -43,6 +44,31 @@ if [ "$dt" -gt "${GRAFT_COST_BUDGET_S:-10}" ]; then
     echo "FAIL: cost tier exceeded its ${GRAFT_COST_BUDGET_S:-10}s budget (${dt}s)" >&2
     exit 1
 fi
+
+echo "== graftlint tier 4 (concurrency, budget ${GRAFT_CONC_BUDGET_S:-10}s; incl. lock-graph smoke) =="
+# Interprocedural concurrency & buffer-lifetime analysis (lock-order
+# cycles, blocking-under-lock, use-after-donate, chaos-coverage drift,
+# thread/lock registry drift) is pure AST — stdlib-only like tier 1 —
+# and must stay interactive-fast under its own declared budget knob.
+# ONE invocation serves both gates: its exit code is the findings gate
+# (set -e aborts on failure) and its captured stdout is the --lock-graph
+# DOT smoke — the graph must stay emittable for human inspection
+# (tools/trace_report.py-style), naming at least the serving drain lock.
+t0=$(date +%s)
+lock_dot=$(tools/lint.sh --tier 4 --lock-graph)
+dt=$(( $(date +%s) - t0 ))
+echo "concurrency tier: ${dt}s"
+if [ "$dt" -gt "${GRAFT_CONC_BUDGET_S:-10}" ]; then
+    echo "FAIL: concurrency tier exceeded its ${GRAFT_CONC_BUDGET_S:-10}s budget (${dt}s)" >&2
+    exit 1
+fi
+case "$lock_dot" in
+    *"digraph lock_graph"*"TfidfServer._lock"*) ;;
+    *) echo "FAIL: --lock-graph emitted no usable DOT graph" >&2
+       printf '%s\n' "$lock_dot" | head -20 >&2
+       exit 1 ;;
+esac
+echo "lock-graph smoke: OK ($(printf '%s\n' "$lock_dot" | grep -c ' -> ') edge(s) emitted)"
 
 echo "== trace-diff gate (per-phase regression across committed rounds) =="
 # Compare the two newest committed BENCH rounds: a per-phase wall-time

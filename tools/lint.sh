@@ -4,16 +4,20 @@
 # Runs ALL analysis tiers over the tier-1 surface (the package, tools/,
 # bench.py): the lexical AST rules (tier 1), the semantic tier that traces
 # every registered jit entry point on the CPU backend (tier 2: recompile /
-# promotion / transfer-census / sharding gates), and the static cost model
+# promotion / transfer-census / sharding gates), the static cost model
 # (tier 3: FLOP/byte intensity floors, pad_frac budgets over the partition
 # plans, and the buffer-donation verifier — intensity gates are advisory
-# while xla_cost_tpu.json is not TPU-measured).  Exit 0 = clean under the
-# ratchet; exit 1 = new findings — fix them, suppress with a justified
-# "# graftlint: disable=<rule>" comment (lexical) or a registry-level
-# suppress entry (semantic/cost), or (outside ops//parallel/) baseline
-# them with a justification.  Pass --tier 1|2|3 to run a single tier,
-# --changed-only for the fast pre-commit path (tools/precommit.sh),
-# --cost-report for the tier-3 per-entry cost table.
+# while xla_cost_tpu.json is not TPU-measured), and the interprocedural
+# concurrency & buffer-lifetime analyzer (tier 4: lock-order cycles,
+# blocking-under-lock, use-after-donate, chaos-coverage drift,
+# thread/lock registry drift — stdlib-only like tier 1).  Exit 0 = clean
+# under the ratchet; exit 1 = new findings — fix them, suppress with a
+# justified "# graftlint: disable=<rule>" comment (lexical/concurrency)
+# or a registry-level suppress entry (semantic/cost), or (outside
+# ops//parallel/) baseline them with a justification.  Pass
+# --tier 1|2|3|4 to run a single tier, --changed-only for the fast
+# pre-commit path (tools/precommit.sh), --cost-report for the tier-3
+# per-entry cost table, --lock-graph for the tier-4 lock graph as DOT.
 #
 # PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
 # can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).

@@ -3,10 +3,13 @@
 #
 #   tools/precommit.sh [BASE]     # default BASE = HEAD (worktree diff)
 #
-# Tier 1 scans just the changed files; tier 2 re-traces only the jit entry
-# points whose contracted module changed (all of them when analysis/ itself
-# changed).  tools/lint.sh remains the full-repo CI gate — this script is
-# the editor-loop companion, typically <2s when nothing jit-adjacent moved.
+# Tier 1 scans just the changed files; tiers 2/3 re-trace only the jit
+# entry points whose contracted module changed (all of them when analysis/
+# itself changed); tier 4 still models the whole surface (interprocedural
+# facts do not restrict — the model is pure AST, well under a second) but
+# reports only findings in the changed files.  tools/lint.sh remains the
+# full-repo CI gate — this script is the editor-loop companion, typically
+# <2s when nothing jit-adjacent moved.
 #
 # PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
 # can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).
