@@ -690,6 +690,110 @@ def _build_tfidf_score_query_batch() -> Traceable:
     return Traceable(fn=fn, variants=variants, anchor=ops.score_query_batch)
 
 
+# Raw per-batch bucket counts the impacted-list planner produces in
+# production (Σ ceil(run/W) over the batch's query terms): run through the
+# REAL carried grow_chunk_cap policy (serving.server.impacted_pad_plan /
+# the planner's cap state) they must collapse to a handful of pow2 caps —
+# the bucket axis of the impacted serving shape matrix.
+IMPACT_BUCKET_MATRIX = (23, 40, 150, 900, 64)
+
+
+def _impacted_pad_plan() -> "list[tuple[str, float]]":
+    from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+        impacted_pad_plan,
+    )
+
+    return impacted_pad_plan(IMPACT_BUCKET_MATRIX)
+
+
+def _build_tfidf_score_impacted_batch() -> Traceable:
+    """The latency-shaped serving scorer (ISSUE 13, serving/server.py
+    drives it): CSC-by-term posting runs padded into fixed-width buckets,
+    one reshape→gather→scatter-add program per (batch cap, bucket cap)
+    point — work ∝ the batch's query terms' posting runs, not nnz."""
+    import functools
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+        grow_chunk_cap,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
+    from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+        IMPACT_MIN_BUCKET_BITS,
+        batch_cap,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import (
+        MetricsRecorder,
+    )
+
+    nnz, n_docs, k, w = 2048, 32, 8, 8
+    metrics = MetricsRecorder()
+    # the bucket caps the declared raw counts produce under the carried
+    # pow2 policy — the same state discipline the serving planner keeps
+    bcap = 0
+    bcaps = []
+    for raw in IMPACT_BUCKET_MATRIX:
+        bcap, _ = grow_chunk_cap(max(raw, 1), bcap, metrics,
+                                 min_bits=IMPACT_MIN_BUCKET_BITS)
+        bcaps.append(bcap)
+    variants = []
+    seen: set = set()
+    for b, bc in zip(SERVE_BATCH_MATRIX, bcaps + bcaps[: max(
+            0, len(SERVE_BATCH_MATRIX) - len(bcaps))]):
+        cap = batch_cap(b, SERVE_MAX_BATCH, metrics)
+        if (cap, bc) in seen:
+            continue
+        seen.add((cap, bc))
+        variants.append(
+            (
+                f"b{cap}-c{bc}",
+                (
+                    _i32((cap,)),  # batch marker: dispatch reads batch here
+                    _i32((nnz,)), _f32((nnz,)),
+                    _i32((bc,)), _i32((bc,)), _i32((bc,)), _f32((bc,)),
+                    _f32((n_docs,)),
+                ),
+            )
+        )
+
+    fn = functools.partial(
+        ops.score_impacted_batch, n_docs=n_docs, bucket_width=w, k=k,
+        use_prior=True,
+    )
+
+    def dispatch(marker, doc, weight, bs, bl, br, bqw, prior):
+        # the padded batch cap is a static of the inner jit; the marker
+        # array's length names which compiled program a variant exercises
+        return fn(doc, weight, bs, bl, br, bqw, prior,
+                  batch=marker.shape[0])
+
+    # donate=() rides the default surface: the dispatch wrapper lowers
+    # whole (marker included) and must record ZERO aliased inputs
+    return Traceable(
+        fn=dispatch,
+        variants=variants,
+        anchor=ops.score_impacted_batch,
+    )
+
+
+def _build_tfidf_topk_merge() -> Traceable:
+    """Device-side per-segment top-k merge (serving across live delta
+    segments): concat + re-rank + id globalization in one fused program;
+    one compile per (segment count, batch cap) pair."""
+    import functools
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
+
+    b, k = 8, 8
+    fn = functools.partial(ops.topk_merge, k=k)
+    variants = []
+    for s in (2, 3):
+        scores = tuple(_f32((b, k)) for _ in range(s))
+        ids = tuple(_i32((b, k)) for _ in range(s))
+        bases = tuple(_i32(()) for _ in range(s))
+        variants.append((f"s{s}", (scores, ids, bases)))
+    return Traceable(fn=fn, variants=variants, anchor=ops.topk_merge)
+
+
 # ---------------------------------------------------- dataflow workloads
 
 
@@ -1044,5 +1148,50 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         # fallback shape; batching raises intensity monotonically, the
         # quantitative case for the micro-batcher)
         intensity_floor=0.04,
+    ),
+    EntryPoint(
+        name="tfidf_score_impacted_batch",
+        module=f"{_PKG}/ops/tfidf.py",
+        build=_build_tfidf_score_impacted_batch,
+        # the bucket planner + carried-cap policy live in serving/server.py
+        # over grow_chunk_cap; the CSC offsets come from serving/artifact.py
+        # (and segment sets re-derive them in serving/segments.py) — a
+        # change to any of them must re-verify this contract
+        watch=(
+            f"{_PKG}/serving/server.py",
+            f"{_PKG}/serving/artifact.py",
+            f"{_PKG}/serving/segments.py",
+            f"{_PKG}/models/tfidf.py",
+            f"{_PKG}/dataflow/ingest.py",
+        ),
+        # one compile per (padded batch cap, carried bucket cap) point of
+        # the declared matrices — anything beyond means an unpadded shape
+        # reached jit on the latency path
+        max_compiles=8,
+        pad_plan=_impacted_pad_plan,
+        # the declared raw bucket counts fill ~44% of the carried pow2
+        # caps (pad_frac ~0.56 includes the 2**IMPACT_MIN_BUCKET_BITS
+        # floor at tiny batches); bounded so planner drift cannot silently
+        # triple the dispatched bucket axis
+        pad_frac_ceiling=0.62,
+        # donation contract: the scorer must alias NOTHING — every operand
+        # (postings, weight table, prior) is reused by the next batch, so
+        # a donation sneaking in would consume live serving state
+        donate=(),
+        intensity_floor=0.03,  # static model: 0.049 at b1-c64 (worst —
+        # the single-request floor shape; larger batches amortize the
+        # postings traffic exactly like the COO entry's matrix does)
+    ),
+    EntryPoint(
+        name="tfidf_topk_merge",
+        module=f"{_PKG}/ops/tfidf.py",
+        build=_build_tfidf_topk_merge,
+        watch=(f"{_PKG}/serving/server.py",),
+        # one compile per live-segment count at the warmed batch cap
+        max_compiles=2,
+        # same must-alias-nothing contract as the scorer: per-segment
+        # candidate buffers belong to their dispatches
+        donate=(),
+        intensity_floor=0.03,  # static model measures 0.053 (s2)
     ),
 )

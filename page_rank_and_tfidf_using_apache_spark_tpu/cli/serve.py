@@ -65,6 +65,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-REQUEST PageRank-prior scale: enables the "
                         "'prior' ranker (@prior prefix) for exactly the "
                         "queries that opt in")
+    p.add_argument("--scoring", choices=["coo", "impacted"], default="coo",
+                   help="serving path: 'coo' scores every query batch "
+                        "against the full postings; 'impacted' slices only "
+                        "the batch's query terms' posting runs from the "
+                        "CSC-by-term layout (byte-equal results, work "
+                        "proportional to the query, not the corpus)")
+    p.add_argument("--impact-bucket-width", type=int, default=8,
+                   help="fixed bucket width the impacted planner pads "
+                        "posting runs to")
     p.add_argument("--no-mmap", action="store_true",
                    help="copy the index into RAM instead of mapping it")
     p.add_argument("--trace-dir", default=None,
@@ -79,8 +88,18 @@ def main(argv: list[str] | None = None) -> int:
 
 
 def _main(args) -> int:
-    index = load_index(args.index, version=args.version,
-                       mmap=not args.no_mmap)
+    from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+        segments as sgm,
+    )
+
+    # A segmented index directory (delta commits of the streaming ingest)
+    # serves its whole live set — merged on device; a plain artifact
+    # directory serves its LATEST version exactly as before.
+    if args.version is None and sgm.manifest_version(args.index) is not None:
+        index = sgm.load_segment_set(args.index, mmap=not args.no_mmap)
+    else:
+        index = load_index(args.index, version=args.version,
+                           mmap=not args.no_mmap)
     cfg = ServeConfig(
         top_k=args.top_k,
         max_batch=args.max_batch,
@@ -88,6 +107,8 @@ def _main(args) -> int:
         cache_size=args.cache_size,
         rank_alpha=args.rank_alpha,
         prior_alpha=args.prior_alpha,
+        scoring=args.scoring,
+        impact_bucket_width=args.impact_bucket_width,
     )
     # Live SLO telemetry (ISSUE 11): with GRAFT_METRICS_PORT set, the
     # serve process exposes /snapshot.json + /metrics over the default

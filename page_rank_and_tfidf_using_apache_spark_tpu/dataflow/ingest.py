@@ -554,8 +554,13 @@ def chunked_ingest(
     def commit_and_release() -> None:
         # the barrier guarantees nothing is in flight here: once the
         # carry pull lands, the drained chunks are durably committed and
-        # their retained host copies can go
+        # their retained host copies can go.  The commit-point event is
+        # what downstream consumers key on — a delta-segment seal
+        # (serving/segments.py) is exactly "everything up to this commit
+        # is durable", so the trace shows when servable state existed.
         commit()
+        obs.emit("ingest_commit", chunks=len(comp_iv),
+                 retained=len(drained))
         drained.clear()
 
     def maybe_checkpoint() -> None:

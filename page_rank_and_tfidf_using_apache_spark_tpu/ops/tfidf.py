@@ -326,3 +326,84 @@ def score_query_batch(
 
     scores = jax.vmap(one)(qdense)
     return jax.lax.top_k(scores, k)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_docs", "batch", "bucket_width", "k", "use_prior"))
+def score_impacted_batch(
+    doc,  # int32 [nnz] CSC-by-term postings: doc ids, term-major order
+    weight,  # f[nnz] ranker weight table over the SAME rows
+    bucket_start,  # int32 [C] postings offset of each bucket's first row
+    bucket_len,  # int32 [C] live rows in the bucket (0 for pad buckets)
+    bucket_row,  # int32 [C] padded query row the bucket scores into
+    bucket_qw,  # f[C] query weight of the bucket's term (0 for pads)
+    doc_prior,  # f[n_docs] additive prior (e.g. scaled PageRank)
+    *,
+    n_docs: int,
+    batch: int,
+    bucket_width: int,
+    k: int,
+    use_prior: bool = False,
+):
+    """The latency-shaped serving scorer (ISSUE 13): score a padded query
+    micro-batch against ONLY the batch's query terms' posting runs.
+
+    :func:`score_query_batch` is throughput-shaped — every dispatch pays a
+    ``[B, vocab]`` scatter plus a ``[B, nnz]`` gather over the WHOLE
+    postings table, so p50 grows with corpus nnz whatever the query asks.
+    Here the host (serving/server.py) slices each query term's posting run
+    out of the CSC-by-term layout (``term_offsets`` in the index artifact)
+    and pads the runs into fixed-width buckets — ``sort_shuffle``'s
+    fixed-bucket trick applied to postings — so the device program is pure
+    reshape → gather → scatter-add over ``C·W`` postings rows, where
+    ``C·W ≈ Σ df(query terms)``, independent of corpus nnz.
+
+    Byte-equality with the full-COO path is load-bearing (the serving A/B
+    is pinned, not hoped): per (row, doc) the contributions arrive in the
+    same order the COO path adds them — query terms ascending (the host
+    planner walks the canonical term-sorted query), docs ascending within
+    a run (the artifact is (term, doc)-sorted) — and every pad slot
+    contributes an exact ``±0.0``, which IEEE addition absorbs.  The same
+    multiply association ``(weight · q) · mask`` is kept so rounding is
+    identical.
+
+    Pad buckets carry ``len 0, row 0, qw 0``; dead lanes of a partial
+    bucket are masked the same way.  ``batch``/``bucket_width`` are static
+    (the compile signature is one (batch cap, bucket cap) point of the
+    serving shape matrix); the outputs are per-query top-k over the
+    LOCAL doc-id space — the segment merge (:func:`topk_merge`)
+    globalizes ids.
+    """
+    lane = jnp.arange(bucket_width, dtype=jnp.int32)[None, :]  # [1, W]
+    idx = bucket_start[:, None] + lane  # [C, W]
+    live = lane < bucket_len[:, None]  # bool [C, W]
+    safe = jnp.where(live, idx, 0)
+    mask = live.astype(weight.dtype)
+    contrib = weight[safe] * bucket_qw[:, None] * mask
+    rows = jnp.broadcast_to(bucket_row[:, None], safe.shape)
+    cols = jnp.where(live, doc[safe], 0)
+    scores = jnp.zeros((batch, n_docs), weight.dtype).at[rows, cols].add(
+        contrib
+    )
+    if use_prior:
+        scores = scores + doc_prior
+    return jax.lax.top_k(scores, k)
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def topk_merge(seg_scores, seg_ids, seg_bases, *, k: int):
+    """Device-side merge of per-segment top-k candidates (ISSUE 13):
+    ``seg_scores``/``seg_ids`` are tuples of per-segment ``[B, k_i]``
+    arrays (local doc ids), ``seg_bases`` the per-segment global doc-id
+    bases.  Candidates are globalized and re-ranked in ONE fused program,
+    so only ``[B, k]`` ever crosses device→host however many live
+    segments a query fans out over.  Ties keep the earlier (older,
+    lower-base) segment — ``lax.top_k`` is stable in input position."""
+    scores = jnp.concatenate(list(seg_scores), axis=1)
+    ids = jnp.concatenate(
+        [i + jnp.asarray(b, i.dtype) for i, b in zip(seg_ids, seg_bases)],
+        axis=1,
+    )
+    top, pos = jax.lax.top_k(scores, k)
+    return top, jnp.take_along_axis(ids, pos, axis=1)

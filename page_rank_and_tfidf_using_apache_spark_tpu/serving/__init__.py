@@ -25,24 +25,38 @@ from page_rank_and_tfidf_using_apache_spark_tpu.serving.artifact import (
     load_index,
     save_index,
 )
+from page_rank_and_tfidf_using_apache_spark_tpu.serving.segments import (
+    SegmentMerger,
+    SegmentSet,
+    commit_append,
+    load_segment_set,
+    seal_segment,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
     RANKERS,
     ServeConfig,
     TfidfServer,
     batch_cap,
+    impacted_pad_plan,
     serve_pad_plan,
 )
 
 __all__ = [
     "RANKERS",
+    "SegmentMerger",
+    "SegmentSet",
     "ServableIndex",
     "ServeConfig",
     "SoakConfig",
     "TfidfServer",
     "batch_cap",
+    "commit_append",
+    "impacted_pad_plan",
     "load_index",
+    "load_segment_set",
     "run_soak",
     "save_index",
+    "seal_segment",
     "serve_pad_plan",
 ]
 

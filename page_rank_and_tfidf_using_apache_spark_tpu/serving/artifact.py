@@ -64,6 +64,16 @@ class ServableIndex:
     # BM25 weights over the SAME postings rows (dataflow/bm25.py) — the
     # A/B-able second ranker; None on indexes built without it.
     bm25_weight: np.ndarray | None = None
+    # CSC-by-term postings offsets (ISSUE 13): ``term_offsets[t] ..
+    # term_offsets[t+1]`` is term t's posting run in the (term, doc)-sorted
+    # COO above — the host-side slice table of the impacted-list scorer.
+    # Always present after load (computed for pre-offsets artifacts).
+    term_offsets: np.ndarray | None = None  # int64 [vocab + 1]
+    # Raw per-pair counts + per-doc lengths (save_index(..., counts=True),
+    # the delta-segment layout): what a reader needs to RE-weight this
+    # segment's postings under index-wide statistics (serving/segments.py).
+    count: np.ndarray | None = None  # f[nnz]
+    doc_lengths: np.ndarray | None = None  # int32 [n_docs]
 
     @property
     def nnz(self) -> int:
@@ -74,6 +84,23 @@ class ServableIndex:
         return 1 << self.vocab_bits
 
 
+def build_term_offsets(term: np.ndarray, vocab: int) -> np.ndarray:
+    """CSC-by-term offsets over a term-sorted postings COO: offsets[t] ..
+    offsets[t+1] is term t's posting run.  int64 so a web-scale nnz can
+    never wrap; the impacted-list planner slices with it host-side."""
+    offsets = np.zeros(vocab + 1, np.int64)
+    if term.shape[0]:
+        offsets[1:] = np.cumsum(np.bincount(term, minlength=vocab))
+    return offsets
+
+
+def _term_sorted(doc: np.ndarray, term: np.ndarray) -> bool:
+    if term.shape[0] < 2:
+        return True
+    t0, t1 = term[:-1], term[1:]
+    return bool(np.all((t1 > t0) | ((t1 == t0) & (doc[1:] >= doc[:-1]))))
+
+
 def save_index(
     directory: str,
     output: TfidfOutput,
@@ -81,6 +108,7 @@ def save_index(
     *,
     ranks: np.ndarray | None = None,
     bm25: Bm25Config | None = None,
+    counts: bool = False,
     extra: dict | None = None,
 ) -> str:
     """Serialize a TF-IDF build (+ optional PageRank doc prior and BM25
@@ -93,19 +121,51 @@ def save_index(
     ``bm25`` re-weights the SAME postings COO from the output's raw
     counts (dataflow/bm25.py) into one extra array, making the artifact
     servable under either ranker per request.
+
+    The postings are stored strictly (term, doc)-sorted with a CSC-by-term
+    ``term_offsets`` table (ISSUE 13): the batch pipeline already emits
+    that order, the streaming pipeline's chunk-major concatenation is
+    re-sorted here ONCE at build time so the serving side can slice a
+    term's whole posting run by offset — the impacted-list layout.
+    ``counts=True`` additionally persists the raw per-pair counts and
+    per-doc lengths, which is what makes a *delta segment* self-contained:
+    a reader can re-weight this slice of the corpus under index-wide
+    DF/N statistics (serving/segments.py) without ever re-ingesting it.
     """
     if ranks is not None and ranks.shape[0] != output.n_docs:
         raise ValueError(
             f"ranks prior has {ranks.shape[0]} entries but the index holds "
             f"{output.n_docs} documents"
         )
+    doc = np.ascontiguousarray(output.doc, np.int32)
+    term = np.ascontiguousarray(output.term, np.int32)
+    weight = np.ascontiguousarray(output.weight)
+    count = (np.ascontiguousarray(output.count)
+             if output.count is not None else None)
+    perm: np.ndarray | None = None
+    if not _term_sorted(doc, term):
+        perm = np.lexsort((doc, term))
+        doc, term, weight = doc[perm], term[perm], weight[perm]
+        if count is not None:
+            count = count[perm]
     arrays: dict[str, np.ndarray] = {
-        "doc": np.ascontiguousarray(output.doc, np.int32),
-        "term": np.ascontiguousarray(output.term, np.int32),
-        "weight": np.ascontiguousarray(output.weight),
+        "doc": doc,
+        "term": term,
+        "weight": weight,
         "idf": np.ascontiguousarray(output.idf),
         "df": np.ascontiguousarray(output.df),
+        "term_offsets": build_term_offsets(term, cfg.vocab_size),
     }
+    if counts:
+        if count is None or output.doc_lengths is None:
+            raise ValueError(
+                "counts=True needs TfidfOutput.count/doc_lengths — rebuild "
+                "with a pipeline version that exports raw counts"
+            )
+        arrays["count"] = count
+        arrays["doc_lengths"] = np.ascontiguousarray(
+            output.doc_lengths, np.int32
+        )
     if ranks is not None:
         arrays["ranks"] = np.ascontiguousarray(ranks)
     if bm25 is not None:
@@ -113,9 +173,8 @@ def save_index(
             bm25_from_tfidf,
         )
 
-        arrays["bm25_weight"] = np.ascontiguousarray(
-            bm25_from_tfidf(output, bm25)
-        )
+        bw = np.ascontiguousarray(bm25_from_tfidf(output, bm25))
+        arrays["bm25_weight"] = bw if perm is None else bw[perm]
     version = ckpt.next_version(directory)
     meta = {
         "format": INDEX_FORMAT,
@@ -170,6 +229,16 @@ def load_index(
             f"{INDEX_FORMAT} — rebuild the artifact"
         )
     cfg = TfidfConfig(**extra["tfidf_config"])
+    offsets = arrays.get("term_offsets")
+    if offsets is None:
+        # pre-ISSUE-13 artifact: same COO meaning, no stored offsets —
+        # derive them at load when the postings happen to be term-sorted
+        # (every batch-built artifact).  A legacy chunk-major streaming
+        # artifact keeps offsets None and serves via the COO path only;
+        # artifacts THIS build writes are always sorted at save time.
+        t = np.asarray(arrays["term"])
+        if _term_sorted(np.asarray(arrays["doc"]), t):
+            offsets = build_term_offsets(t, 1 << int(extra["vocab_bits"]))
     return ServableIndex(
         path=path,
         version=int(ver),
@@ -184,4 +253,7 @@ def load_index(
         ranks=arrays.get("ranks"),
         extra=extra,
         bm25_weight=arrays.get("bm25_weight"),
+        term_offsets=offsets,
+        count=arrays.get("count"),
+        doc_lengths=arrays.get("doc_lengths"),
     )

@@ -1,5 +1,6 @@
 """Long-lived TF-IDF query server: warm compiled runners, padded
-micro-batches, device-fused top-k, hot-query LRU cache (ISSUE 8).
+micro-batches, device-fused top-k, hot-query LRU cache (ISSUE 8), and —
+since ISSUE 13 — impacted-list scoring over live delta segments.
 
 Request lifecycle::
 
@@ -8,9 +9,14 @@ Request lifecycle::
                                                  ▼
                       pad to batch cap (grow_chunk_cap, min_bits=0)
                                                  ▼
-                      ops.score_query_batch  (ONE jit dispatch, top-k
-                      fused on device — full score vectors never cross
-                      device→host)
+            ┌─ scoring="coo":      ops.score_query_batch per segment
+            └─ scoring="impacted": host planner slices each query term's
+               posting run from the CSC-by-term offsets, pads the runs
+               into fixed-width buckets, ONE ops.score_impacted_batch
+               dispatch per segment — work ∝ Σ df(query terms), not nnz
+                                                 ▼
+                      >1 live segment: ops.topk_merge (device-side,
+                      globalizes doc ids; only [B, k] crosses D2H)
                                                  ▼
                       guarded pull ──► per-request futures resolve
 
@@ -18,10 +24,29 @@ Design points, each load-bearing for the acceptance gates:
 
 - **Finite batch-shape matrix.**  A micro-batch of ``b`` misses pads to
   ``grow_chunk_cap(b, 0, min_bits=0)`` — the next power of two — clipped
-  by ``max_batch``, so the only shapes that ever reach jit are
+  by ``max_batch``, so the only batch shapes that ever reach jit are
   ``{1, 2, 4, ..., max_batch}``.  :func:`TfidfServer.warmup` compiles all
-  of them up front; the ``tfidf_score_query_batch`` registry entry traces
-  the same matrix, so tier-2 *proves* zero per-request recompiles.
+  of them up front; the ``tfidf_score_query_batch`` /
+  ``tfidf_score_impacted_batch`` registry entries trace the same matrix,
+  so tier-2 *proves* zero per-request recompiles.  The impacted path adds
+  ONE more padded axis — the bucket count, carried pow2 like the ingest
+  chunk cap (``grow_chunk_cap`` at ``IMPACT_MIN_BUCKET_BITS``) — so a
+  heavier query stream bumps the cap with a logged recompile instead of
+  compiling per shape.
+- **Latency shape.**  ``scoring="impacted"`` makes served work
+  proportional to the batch's query terms' posting runs: the host slices
+  ``[start, len)`` runs from the artifact's ``term_offsets`` table and
+  the device program is reshape → gather → scatter-add over ``C·W`` rows.
+  Results are byte-equal to the full-COO path (pinned per ranker): the
+  contributions arrive per (row, doc) in the same order segment_sum adds
+  them, and pad slots add exact ``±0.0``.
+- **Segments.**  The server holds N live segments (delta commits of the
+  streaming ingest — serving/segments.py) and scores a batch across all
+  of them with a device-side merge of per-segment top-k.
+  :meth:`refresh_segments` hot-swaps the live set WITHOUT restart: the
+  replacement device state is built and warmed first, then swapped under
+  the cache lock; in-flight batches finish against the old (still live)
+  buffers, the result cache is invalidated by generation.
 - **Resilience.**  The dispatch and the pull run under the resilience
   executor (sites ``serve_dispatch`` / ``serve_pull``): transient faults
   retry invisibly; a persistent fault fails exactly the requests of the
@@ -57,7 +82,18 @@ from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import grow_chunk_c
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import tfidf as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.serving.artifact import ServableIndex
+from page_rank_and_tfidf_using_apache_spark_tpu.serving.segments import (
+    LoadedSegment,
+    SegmentSet,
+    wrap_index_as_set,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
+
+# Floor of the impacted-list bucket-count cap: the carried pow2 cap starts
+# at 2**this and doubles on demand (a logged recompile), exactly the
+# streaming chunk-cap policy at a serving-sized floor.
+IMPACT_MIN_BUCKET_BITS = 6
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,6 +113,17 @@ class ServeConfig:
     # enables ranker="prior" (tfidf weights + prior_alpha * ranks for
     # exactly the requests that opt in); the prior rides as a traced
     # operand, so the compiled batch matrix is shared with tfidf/bm25
+    scoring: str = "coo"  # "coo" (full-postings batch scatter/gather) or
+    # "impacted" (CSC-by-term run slicing — work ∝ the query's terms'
+    # posting runs; byte-equal results, latency-shaped cost)
+    impact_bucket_width: int = 8  # fixed bucket width W the impacted
+    # planner pads posting runs to (sort_shuffle's bucket trick)
+    impact_warm_buckets: int = 1 << 13  # ceiling on the bucket cap the
+    # warmup PRE-GROWS to (sized from the live set's heaviest posting
+    # runs): a cap bump is a recompile ON the serving path, so warmup
+    # sizes the carried cap for the worst plausible batch up front —
+    # runtime can still grow past this (logged), it just shouldn't have
+    # to in steady state
 
     def __post_init__(self) -> None:
         if self.top_k < 1:
@@ -92,6 +139,20 @@ class ServeConfig:
         if self.cache_size < 0 or self.rank_alpha < 0 or self.prior_alpha < 0:
             raise ValueError(
                 "cache_size, rank_alpha and prior_alpha must be >= 0"
+            )
+        if self.scoring not in ("coo", "impacted"):
+            raise ValueError(
+                f"scoring must be 'coo' or 'impacted', got {self.scoring!r}"
+            )
+        if self.impact_bucket_width < 2:
+            raise ValueError(
+                f"impact_bucket_width must be >= 2, got "
+                f"{self.impact_bucket_width}"
+            )
+        if self.impact_warm_buckets < (1 << IMPACT_MIN_BUCKET_BITS):
+            raise ValueError(
+                f"impact_warm_buckets must be >= {1 << IMPACT_MIN_BUCKET_BITS}, "
+                f"got {self.impact_warm_buckets}"
             )
 
 
@@ -132,6 +193,26 @@ def serve_pad_plan(
         total_cap += batch_cap(int(b), max_batch, metrics)
     pad_frac = (total_cap - total_raw) / max(total_cap, 1)
     return [("serve", pad_frac)]
+
+
+def impacted_pad_plan(
+    bucket_counts: Sequence[int], *, min_bits: int = IMPACT_MIN_BUCKET_BITS
+) -> list[tuple[str, float]]:
+    """Static padding-waste plan of the impacted-list bucket axis: raw
+    per-batch bucket counts through the REAL carried grow_chunk_cap
+    policy (pow2 floor ``2**min_bits``, doubling bumps) — the tier-3
+    pad_frac surface for ``tfidf_score_impacted_batch``."""
+    metrics = MetricsRecorder()
+    cap = 0
+    total_raw = 0
+    total_cap = 0
+    for n in bucket_counts:
+        cap, _ = grow_chunk_cap(max(int(n), 1), cap, metrics,
+                                min_bits=min_bits)
+        total_raw += int(n)
+        total_cap += cap
+    pad_frac = (total_cap - total_raw) / max(total_cap, 1)
+    return [("impacted", pad_frac)]
 
 
 # "prior" scores with the tfidf weight table plus the per-request
@@ -200,8 +281,93 @@ class _Pending:
 _STOP = object()
 
 
+@dataclasses.dataclass
+class _DevSegment:
+    """Device-resident serving state of ONE live segment."""
+
+    name: str
+    doc_base: int
+    n_docs: int
+    nnz: int
+    k: int  # per-segment top-k width (min(server k, n_docs))
+    dev_doc: object  # int32 [nnz] on device
+    dev_term: object  # int32 [nnz] on device (COO path; None on impacted)
+    valid: object  # f[nnz] on device (COO path; None on impacted)
+    weights: dict  # ranker -> device weight table [nnz]
+    offsets: np.ndarray | None  # int64 [vocab+1] host CSC slice table
+    # (None only on a legacy COO-only artifact)
+    ranks: np.ndarray | None  # host prior source (segment-local slice)
+    prior: object = None  # device every-request blend operand [n_docs]
+    prior_req: object = None  # device ranker="prior" operand [n_docs]
+
+
+@dataclasses.dataclass(frozen=True)
+class _ServingView:
+    """What ``server.index`` exposes when the server fronts a segment set
+    (aggregate stats; the per-segment artifacts live in the set)."""
+
+    version: int
+    n_docs: int
+    nnz: int
+    vocab_bits: int
+    cfg: TfidfConfig
+    weight: np.ndarray  # zero-length dtype carrier
+    ranks: np.ndarray | None
+    bm25_weight: np.ndarray | None
+    segments: int
+
+    @property
+    def vocab_size(self) -> int:
+        return 1 << self.vocab_bits
+
+
+def _set_view(segset: SegmentSet) -> _ServingView:
+    dtype = segset.segments[0].weights["tfidf"].dtype
+    marker = np.zeros(0, dtype)
+    return _ServingView(
+        version=segset.version,
+        n_docs=segset.n_docs,
+        nnz=segset.nnz,
+        vocab_bits=segset.vocab_bits,
+        cfg=segset.cfg,
+        weight=marker,
+        ranks=marker if segset.has_ranks else None,
+        bm25_weight=marker if segset.has_bm25 else None,
+        segments=len(segset.segments),
+    )
+
+
+def _check_impacted_servable(cfg: ServeConfig, segset: SegmentSet) -> None:
+    """The impacted path needs real CSC offsets: a legacy (pre-offsets,
+    non-term-sorted) artifact loads with ``term_offsets=None`` and can
+    only serve via the COO path — refusing beats silently slicing runs
+    that do not exist."""
+    if cfg.scoring != "impacted":
+        return
+    for seg in segset.segments:
+        if seg.term_offsets is None:
+            raise ValueError(
+                f"scoring='impacted' needs the CSC-by-term offsets, but "
+                f"segment {seg.ref.name} is a legacy non-term-sorted "
+                "artifact (COO-only) — rebuild it with this version, or "
+                "serve with scoring='coo'"
+            )
+        if seg.ref.nnz >= 1 << 31:
+            # bucket_start rides int32 on device; a single segment past
+            # 2^31 postings would wrap its run starts into silently wrong
+            # scores.  Split the corpus into segments (the layout this
+            # PR exists for) instead of widening the device index path.
+            raise ValueError(
+                f"segment {seg.ref.name} holds {seg.ref.nnz} postings — "
+                "impacted scoring addresses segments with int32 offsets; "
+                "split the index into (merge-bounded) segments under "
+                "2^31 nnz each"
+            )
+
+
 class TfidfServer:
-    """The long-lived online query path over one :class:`ServableIndex`.
+    """The long-lived online query path over one :class:`ServableIndex`
+    or a live :class:`~..serving.segments.SegmentSet`.
 
     Usage::
 
@@ -211,91 +377,112 @@ class TfidfServer:
 
     ``start()`` device-puts the postings once and (by default) warms every
     padded batch shape, so steady state never compiles; ``submit`` is
-    thread-safe and returns a future.
+    thread-safe and returns a future.  A segmented server additionally
+    supports :meth:`refresh_segments` — hot-swapping the live set (a new
+    delta commit, a background merge) WITHOUT restart.
     """
 
     def __init__(
         self,
-        index: ServableIndex,
+        index: "ServableIndex | SegmentSet",
         cfg: ServeConfig = ServeConfig(),
         *,
         metrics: MetricsRecorder | None = None,
     ):
-        if index.n_docs < 1 or index.nnz < 1:
+        if isinstance(index, SegmentSet):
+            segset = index
+            self.index: "ServableIndex | _ServingView" = _set_view(segset)
+        else:
+            segset = wrap_index_as_set(index)
+            self.index = index
+        if segset.n_docs < 1 or segset.nnz < 1:
             raise ValueError("cannot serve an empty index")
-        if (cfg.rank_alpha > 0 or cfg.prior_alpha > 0) and index.ranks is None:
+        if (cfg.rank_alpha > 0 or cfg.prior_alpha > 0) and not segset.has_ranks:
             raise ValueError(
                 "rank_alpha/prior_alpha > 0 needs a PageRank prior in the "
                 "index (save_index(..., ranks=...))"
             )
-        self.index = index
+        _check_impacted_servable(cfg, segset)
+        self._segset = segset
         self.cfg = cfg
         self.metrics = metrics or MetricsRecorder()
-        self.k = min(cfg.top_k, index.n_docs)
+        self.k = min(cfg.top_k, segset.n_docs)
         self._queue: queue.Queue = queue.Queue(maxsize=cfg.queue_depth)
         self._thread: threading.Thread | None = None
         self._started = False
-        self._valid = None
-        self._weights: dict = {}
         self._cache: collections.OrderedDict[bytes, tuple] = collections.OrderedDict()
-        self._lock = threading.Lock()  # cache + stats
+        self._lock = threading.Lock()  # cache + stats + live segment list
         # Orders submit()'s {started-check, enqueue} against stop()'s flag
         # flip.  Deliberately NOT self._lock: the drain thread takes that
         # one per batch, and a submitter may block on a full queue while
         # holding this lock — the drain must be free to keep consuming.
         self._submit_lock = threading.Lock()
         self._stats = collections.Counter()
-        self._dev: tuple | None = None  # device-resident postings
-        self._prior = None  # every-request prior operand (rank_alpha blend)
-        self._prior_req = None  # ranker="prior" operand (+= prior_alpha)
-        self._prior_gen = 0  # bumped per operand swap; stale-cache guard
+        self._segs: list[_DevSegment] = []  # live device state, base order
+        self._prior_gen = 0  # bumped per prior swap AND per segment
+        # refresh; the stale-result cache guard
         self._use_prior = False
-        self._runner = None
+        self._bucket_cap = 0  # carried impacted bucket-count cap (pow2)
+        # the last hot-swapped GLOBAL prior (set_prior): refresh_segments
+        # re-applies it to the new live set — a commit landing between
+        # two prior ticks must not silently revert serving to the
+        # artifact-carried placeholder priors
+        self._prior_ranks: np.ndarray | None = None
 
     # ------------------------------------------------------------ lifecycle
+
+    def _build_seg(self, seg: LoadedSegment, k: int) -> _DevSegment:
+        """Device-put one segment's serving state (mmap pages fault in
+        exactly once; queries then touch only device memory)."""
+        import jax.numpy as jnp
+
+        idx = seg.index
+        weights = {
+            r: jnp.asarray(np.ascontiguousarray(w))
+            for r, w in seg.weights.items()
+        }
+        coo = self.cfg.scoring == "coo"
+        return _DevSegment(
+            name=seg.ref.name,
+            doc_base=seg.ref.doc_base,
+            n_docs=idx.n_docs,
+            nnz=idx.nnz,
+            k=min(k, idx.n_docs),
+            dev_doc=jnp.asarray(np.ascontiguousarray(idx.doc)),
+            # the term array and validity mask are COO-path operands only
+            # — the impacted scorer consumes doc + weights + host offsets,
+            # so skipping these saves two nnz-sized device buffers per
+            # segment (~140 MB at the 1M-doc bench scale, doubled
+            # transiently during every refresh)
+            dev_term=(jnp.asarray(np.ascontiguousarray(idx.term))
+                      if coo else None),
+            valid=(jnp.ones(idx.nnz, weights["tfidf"].dtype)
+                   if coo else None),
+            weights=weights,
+            offsets=seg.term_offsets,
+            ranks=(np.ascontiguousarray(idx.ranks)
+                   if idx.ranks is not None else None),
+        )
+
+    def _build_segs(self, segset: SegmentSet, k: int) -> list[_DevSegment]:
+        segs = [self._build_seg(s, k) for s in segset.segments]
+        with self._lock:
+            ranks = self._prior_ranks
+        self._apply_prior(segs, ranks)
+        return segs
 
     def start(self, warm: bool = True) -> "TfidfServer":
         """Load device state and launch the drain thread.  ``warm=True``
         compiles every padded batch shape before the first request."""
         if self._started:
             return self
-        import jax.numpy as jnp
-
-        idx = self.index
-        with obs.span("serve.load", version=idx.version, nnz=idx.nnz):
-            # the artifact arrays are mmap views; device_put pages them in
-            # exactly once, then queries touch only device memory.  The
-            # per-ranker weight tables live side by side over the SAME
-            # doc/term postings; ranker selection swaps a traced operand,
-            # never a program.
-            self._dev = (
-                jnp.asarray(np.ascontiguousarray(idx.doc)),
-                jnp.asarray(np.ascontiguousarray(idx.term)),
-            )
-            self._valid = jnp.ones(idx.nnz, idx.weight.dtype)
-            self._weights = {
-                "tfidf": jnp.asarray(np.ascontiguousarray(idx.weight)),
-            }
-            if idx.bm25_weight is not None:
-                self._weights["bm25"] = jnp.asarray(
-                    np.ascontiguousarray(
-                        idx.bm25_weight.astype(idx.weight.dtype)
-                    )
-                )
+        segset = self._segset
+        with obs.span("serve.load", version=segset.version, nnz=segset.nnz,
+                      segments=len(segset.segments)):
             self._use_prior = (
                 self.cfg.rank_alpha > 0 or self.cfg.prior_alpha > 0
             )
-            self._set_prior_arrays(
-                np.ascontiguousarray(idx.ranks)
-                if idx.ranks is not None else None
-            )
-        self._runner = functools.partial(
-            ops.score_query_batch,
-            n_docs=idx.n_docs,
-            vocab=idx.vocab_size,
-            k=self.k,
-            use_prior=self._use_prior,
-        )
+            self._segs = self._build_segs(segset, self.k)
         self._started = True
         if warm:
             self.warmup()
@@ -303,65 +490,175 @@ class TfidfServer:
             target=self._drain, name="tfidf-serve-drain", daemon=True
         )
         self._thread.start()
-        obs.emit("serve_start", version=idx.version, n_docs=idx.n_docs,
-                 nnz=idx.nnz, k=self.k, max_batch=self.cfg.max_batch)
+        obs.emit("serve_start", version=segset.version, n_docs=segset.n_docs,
+                 nnz=segset.nnz, k=self.k, max_batch=self.cfg.max_batch,
+                 segments=len(segset.segments), scoring=self.cfg.scoring)
         return self
 
-    def warmup(self) -> list[int]:
-        """Compile (and fence) every padded batch shape the policy can
-        produce.  After this, a request can only ever hit a warm
-        executable — the 'compiled runners warm' half of the tentpole.
-        One pass covers BOTH rankers: the weight table is a traced
-        operand of the same shape/dtype, so tfidf and bm25 share every
-        compiled executable."""
+    def _warm_segs(self, segs: list[_DevSegment], k: int, *,
+                   only: "set[str] | None" = None) -> list[int]:
+        """Compile (and fence) every padded batch shape against ``segs``
+        — shared by start-time warmup and segment refresh, so a request
+        can only ever hit a warm executable.  One pass covers every
+        ranker: the weight table is a traced operand of the same
+        shape/dtype, so tfidf/bm25/prior share every executable.
+        ``only`` restricts the per-segment dispatches to the named (NEW)
+        segments — carried-over segments' executables are already
+        compiled, and re-executing their warm passes on every refresh is
+        pure CPU taken from live traffic; the cross-segment merge is
+        always warmed (its shape depends on the whole set)."""
         caps = batch_shape_matrix(self.cfg.max_batch)
         q = self.cfg.max_query_terms
+        if self.cfg.scoring == "impacted":
+            # Pre-grow the carried bucket cap for a HEAVY plausible batch
+            # — max_batch queries of a few terms each hitting the live
+            # set's heaviest posting run — clipped by impact_warm_buckets.
+            # A cap bump at serve time is an inline recompile on the
+            # latency path; paying it here (bounded) is the
+            # warm-shape-matrix discipline applied to the bucket axis.
+            # Sized for typical traffic, not the adversarial worst
+            # (max_query_terms stopwords): every dispatch gathers the
+            # FULL padded cap, so an over-grown cap taxes each request —
+            # a genuinely heavier stream grows past this with one logged
+            # recompile per doubling.
+            w = self.cfg.impact_bucket_width
+            df_max = max(
+                (int(np.diff(seg.offsets).max()) if seg.offsets.shape[0] > 1
+                 else 0)
+                for seg in segs
+            )
+            heavy_terms = min(q, 4)
+            worst = (self.cfg.max_batch * heavy_terms
+                     * ((df_max + w - 1) // w))
+            target = min(max(worst, 1), self.cfg.impact_warm_buckets)
+            with self._lock:
+                cap_before = self._bucket_cap
+                cap, _ = grow_chunk_cap(
+                    target, self._bucket_cap, self.metrics,
+                    min_bits=IMPACT_MIN_BUCKET_BITS,
+                )
+                self._bucket_cap = max(self._bucket_cap, cap)
+                cap_grew = self._bucket_cap != cap_before
+            if cap_grew:
+                # the bucket axis changed shape for EVERY segment, not
+                # just the new ones: carried-over executables compiled at
+                # the old cap would recompile inline on the first live
+                # request — warm the whole set this pass instead
+                only = None
+        dtype = segs[0].weights["tfidf"].dtype
         for cap in caps:
-            with obs.span("serve.warmup", batch=cap):
+            with obs.span("serve.warmup", batch=cap,
+                          scoring=self.cfg.scoring):
                 zt = np.zeros((cap, q), np.int32)
-                zw = np.zeros((cap, q), self.index.weight.dtype)
-                out = self._runner(
-                    *self._dev, self._weights["tfidf"], self._valid,
-                    zt, zw, zw, self._prior,
-                )
-                rx.block_until_ready(
-                    out, site="serve_warmup", metrics=self.metrics
-                )
+                zw = np.zeros((cap, q), dtype)
+                outs = []
+                warm_set = [s for s in segs
+                            if only is None or s.name in only]
+                for seg in warm_set:
+                    if self.cfg.scoring == "impacted":
+                        zc = np.zeros(self._bucket_cap, np.int32)
+                        outs.append(ops.score_impacted_batch(
+                            seg.dev_doc, seg.weights["tfidf"],
+                            zc, zc, zc, zc.astype(dtype), seg.prior,
+                            n_docs=seg.n_docs, batch=cap,
+                            bucket_width=self.cfg.impact_bucket_width,
+                            k=seg.k, use_prior=self._use_prior,
+                        ))
+                    else:
+                        outs.append(ops.score_query_batch(
+                            seg.dev_doc, seg.dev_term,
+                            seg.weights["tfidf"], seg.valid,
+                            zt, zw, zw, seg.prior,
+                            n_docs=seg.n_docs, vocab=self.vocab_size,
+                            k=seg.k, use_prior=self._use_prior,
+                        ))
+                if len(segs) > 1:
+                    # the merge program's shape depends on the WHOLE live
+                    # set — warm it against zero candidates even when the
+                    # per-segment dispatches were restricted to new ones
+                    outs.append(ops.topk_merge(
+                        tuple(np.zeros((cap, s.k), dtype) for s in segs),
+                        tuple(np.zeros((cap, s.k), np.int32)
+                              for s in segs),
+                        tuple(s.doc_base for s in segs),
+                        k=min(k, sum(s.k for s in segs)),
+                    ))
+                if outs:
+                    rx.block_until_ready(
+                        outs, site="serve_warmup", metrics=self.metrics
+                    )
         return caps
 
-    def _set_prior_arrays(self, ranks: np.ndarray | None) -> None:
-        """(Re)build the two device-resident prior operands from a host
-        ranks vector: the every-request blend (``rank_alpha * ranks``) and
-        the ranker="prior" blend (``(rank_alpha + prior_alpha) * ranks``).
-        Zeros when the server carries no prior."""
+    def warmup(self) -> list[int]:
+        """Compile every padded batch shape the policy can produce for
+        the CURRENT live segment set.  After this, a request can only
+        ever hit a warm executable — the 'compiled runners warm' half of
+        the serving tentpole."""
+        with self._lock:
+            segs = list(self._segs)
+            k = self.k
+        return self._warm_segs(segs, k)
+
+    @property
+    def vocab_size(self) -> int:
+        return 1 << self._segset.vocab_bits
+
+    def _apply_prior(self, segs: list[_DevSegment],
+                     global_ranks: np.ndarray | None) -> None:
+        """(Re)build each segment's two device prior operands — the
+        every-request blend (``rank_alpha·ranks``) and the ranker="prior"
+        blend (``(rank_alpha + prior_alpha)·ranks``) — from a GLOBAL
+        ranks vector (sliced per segment by doc range) or, when None,
+        from each segment's artifact-carried local prior.  Zeros when the
+        server carries no prior.  The device operands are built OUTSIDE
+        the lock (device_put is slow) and assigned to every segment in
+        one locked section, so a batch snapshotting the live set never
+        sees segment A under the new prior and segment B under the old."""
         import jax.numpy as jnp
 
-        dtype = self.index.weight.dtype
-        n = self.index.n_docs
-        if ranks is None or not self._use_prior:
-            base = np.zeros(n, dtype)
-            req = base
-        else:
-            ranks = np.ascontiguousarray(ranks, dtype)
-            base = (self.cfg.rank_alpha * ranks if self.cfg.rank_alpha > 0
-                    else np.zeros(n, dtype))
-            req = base + self.cfg.prior_alpha * ranks
-        base_dev = jnp.asarray(base.astype(dtype))
-        req_dev = (base_dev if req is base
-                   else jnp.asarray(req.astype(dtype)))
+        built = []
+        for seg in segs:
+            dtype = seg.weights["tfidf"].dtype
+            if global_ranks is not None:
+                local = np.ascontiguousarray(
+                    global_ranks[seg.doc_base:seg.doc_base + seg.n_docs],
+                    dtype)
+                if local.shape[0] < seg.n_docs:
+                    # a segment committed AFTER the last set_prior: its
+                    # docs have no global rank yet — give them the
+                    # neutral mean-1 value (priors are mean-normalized)
+                    # until the next prior refresh covers them
+                    pad = np.ones(seg.n_docs - local.shape[0], dtype)
+                    local = np.concatenate([local, pad])
+            elif seg.ranks is not None:
+                local = np.ascontiguousarray(seg.ranks, dtype)
+            else:
+                local = None
+            if local is None or not self._use_prior:
+                base = np.zeros(seg.n_docs, dtype)
+                req = base
+            else:
+                base = (self.cfg.rank_alpha * local
+                        if self.cfg.rank_alpha > 0
+                        else np.zeros(seg.n_docs, dtype))
+                req = base + self.cfg.prior_alpha * local
+            base_dev = jnp.asarray(base.astype(dtype))
+            req_dev = (base_dev if req is base
+                       else jnp.asarray(req.astype(dtype)))
+            built.append((base_dev, req_dev))
         with self._lock:
-            self._prior = base_dev
-            self._prior_req = req_dev
-            self._prior_gen += 1
+            for seg, (base_dev, req_dev) in zip(segs, built):
+                seg.prior = base_dev
+                seg.prior_req = req_dev
 
     def set_prior(self, ranks: np.ndarray) -> None:
         """Hot-swap the PageRank prior on a RUNNING server (the soak's
-        background refresh): rebuilds the prior operands from ``ranks``
-        and invalidates the result cache (cached top-k blended the old
-        prior).  No recompile — the prior is a traced operand of every
-        warm executable.  Requires a server constructed with
-        ``rank_alpha > 0`` or ``prior_alpha > 0`` (otherwise the compiled
-        program has no prior addend to feed)."""
+        background refresh): rebuilds the per-segment prior operands from
+        the GLOBAL ``ranks`` vector and invalidates the result cache
+        (cached top-k blended the old prior).  No recompile — the prior
+        is a traced operand of every warm executable.  Requires a server
+        constructed with ``rank_alpha > 0`` or ``prior_alpha > 0``
+        (otherwise the compiled program has no prior addend to feed)."""
         if not self._started:
             raise RuntimeError("server not started")
         if not self._use_prior:
@@ -370,15 +667,63 @@ class TfidfServer:
                 "ServeConfig(rank_alpha=... ) or ServeConfig(prior_alpha=...)"
             )
         ranks = np.ascontiguousarray(ranks)
-        if ranks.shape != (self.index.n_docs,):
+        with self._lock:
+            segs = list(self._segs)
+            n_docs = sum(s.n_docs for s in segs)
+        if ranks.shape != (n_docs,):
             raise ValueError(
                 f"prior has shape {ranks.shape}; this index holds "
-                f"{self.index.n_docs} documents"
+                f"{n_docs} documents"
             )
-        self._set_prior_arrays(ranks)
+        self._apply_prior(segs, ranks)
         with self._lock:
+            self._prior_ranks = ranks  # re-applied by refresh_segments
+            self._prior_gen += 1
             self._cache.clear()
         obs.emit("serve_prior_update", n_docs=int(ranks.shape[0]))
+
+    def refresh_segments(self, segset: SegmentSet) -> None:
+        """Hot-swap the live segment set WITHOUT restart (a new delta
+        commit, a background merge): device state for the new set is
+        built and warmed FIRST (compiles land here, off the serving
+        path's critical decisions — in-flight batches keep scoring
+        against the old, still-live buffers), then the list is swapped
+        under the lock and the result cache invalidated by generation.
+        Queued and future requests see the new set; nothing is dropped
+        and nothing restarts."""
+        if not self._started:
+            raise RuntimeError("server not started")
+        if segset.cfg.config_hash() != self._segset.cfg.config_hash():
+            raise ValueError(
+                "refusing to refresh across semantic config changes "
+                f"({segset.cfg.config_hash()} != "
+                f"{self._segset.cfg.config_hash()})"
+            )
+        _check_impacted_servable(self.cfg, segset)
+        t0 = time.perf_counter()
+        with obs.span("serve.refresh", version=segset.version,
+                      segments=len(segset.segments)):
+            new_k = min(self.cfg.top_k, segset.n_docs)
+            segs = self._build_segs(segset, new_k)
+            with self._lock:
+                live = {s.name for s in self._segs}
+            self._warm_segs(segs, new_k,
+                            only={s.name for s in segs} - live)
+            with self._lock:
+                self._segset = segset
+                self._segs = segs
+                self.k = new_k
+                self._prior_gen += 1
+                self._cache.clear()
+                self._stats["refreshes"] += 1
+            # submit()'s ranker refusal checks read self.index — it must
+            # describe the LIVE set, whatever the server was built from
+            # (a plain-artifact server keeps its ServableIndex only until
+            # the first refresh makes it stale)
+            self.index = _set_view(segset)
+        obs.emit("serve_refresh", version=segset.version,
+                 segments=len(segset.segments), n_docs=segset.n_docs,
+                 warm_s=round(time.perf_counter() - t0, 4))
 
     def stop(self) -> None:
         with self._submit_lock:
@@ -415,16 +760,16 @@ class TfidfServer:
         into canonical (term_ids, weights) — term-id-sorted, duplicates
         combined (weight = occurrence count, the A11 query vector),
         truncated to the ``max_query_terms`` hot slots."""
-        cfg = self.index.cfg
+        cfg = self._segset.cfg
+        dtype = self.index.weight.dtype
         toks: list[str] = []
         for t in terms:
             toks.extend(tio.tokenize(t, lowercase=cfg.lowercase,
                                      min_token_len=cfg.min_token_len))
         toks = tio.add_ngrams(toks, cfg.ngram)
         if not toks:
-            return (np.zeros(0, np.int32),
-                    np.zeros(0, self.index.weight.dtype))
-        ids = tio.hash_to_vocab(tio.fnv1a_64(toks), self.index.vocab_bits)
+            return (np.zeros(0, np.int32), np.zeros(0, dtype))
+        ids = tio.hash_to_vocab(tio.fnv1a_64(toks), cfg.vocab_bits)
         uniq, counts = np.unique(ids, return_counts=True)
         if uniq.shape[0] > self.cfg.max_query_terms:
             # keep the heaviest terms; stable enough for a hot path and
@@ -433,7 +778,7 @@ class TfidfServer:
             order.sort()
             uniq, counts = uniq[order], counts[order]
             obs.counter("serve.query_truncated")
-        return uniq.astype(np.int32), counts.astype(self.index.weight.dtype)
+        return uniq.astype(np.int32), counts.astype(dtype)
 
     @staticmethod
     def query_key(q_term: np.ndarray, q_weight: np.ndarray,
@@ -496,9 +841,10 @@ class TfidfServer:
     def stats(self) -> dict:
         with self._lock:
             out = {k: int(v) for k, v in self._stats.items()}
+            out["segments"] = len(self._segs)
         out.setdefault("requests", 0)
         for key in ("cache_hits", "cache_misses", "dedup_hits", "batches",
-                    "batch_errors"):
+                    "batch_errors", "refreshes"):
             out.setdefault(key, 0)
         return out
 
@@ -518,9 +864,10 @@ class TfidfServer:
             return
         with self._lock:
             if gen != self._prior_gen:
-                # the batch was dispatched against a prior operand that
-                # set_prior has since hot-swapped: caching it would serve
-                # the stale blend as hits after the invalidation
+                # the batch was dispatched against a prior operand (or a
+                # segment set) that set_prior/refresh_segments has since
+                # hot-swapped: caching it would serve the stale result as
+                # hits after the invalidation
                 return
             self._cache[key] = value
             self._cache.move_to_end(key)
@@ -611,10 +958,69 @@ class TfidfServer:
             for ranker, plist in by_ranker.items():
                 self._serve_group(ranker, plist, batch_size=len(batch))
 
+    @staticmethod
+    def _query_plan(uniq: list[_Pending], dtype):
+        """Segment-INDEPENDENT half of the impacted planner: one flat
+        (row, term id, query weight) triple per query term across the
+        deduped batch — built once per batch, shared by every segment."""
+        n_terms = [p.q_term.shape[0] for p in uniq]
+        if sum(n_terms) == 0:
+            return (np.zeros(0, np.int32), np.zeros(0, np.int64),
+                    np.zeros(0, dtype))
+        rows = np.repeat(np.arange(len(uniq), dtype=np.int32), n_terms)
+        terms = np.concatenate([p.q_term for p in uniq]).astype(np.int64)
+        qws = np.concatenate([p.q_weight for p in uniq]).astype(dtype)
+        return rows, terms, qws
+
+    def _plan_impacted(
+        self, segs: list[_DevSegment], uniq: list[_Pending], dtype
+    ) -> list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]]:
+        """Host half of the impacted-list path: slice every query term's
+        posting run out of each segment's CSC offsets and pad the runs
+        into fixed-width buckets (vectorized — no per-bucket Python).
+        The query-side arrays are built once, the carried pow2 cap is
+        grown ONCE for the batch's worst segment (one lock acquisition —
+        monotonic under the lock, so a refresh warming on another thread
+        can never race the cap back DOWN past an already-compiled size),
+        and each segment gets (start, len, row, qw) arrays at that cap."""
+        W = self.cfg.impact_bucket_width
+        rows, terms, qws = self._query_plan(uniq, dtype)
+        runs = []
+        need = 1
+        for seg in segs:
+            off = seg.offsets
+            starts = off[terms]
+            lens = off[terms + 1] - starts
+            nb = (lens + W - 1) // W  # buckets per run (0 = absent term)
+            total = int(nb.sum())
+            runs.append((starts, lens, nb, total))
+            need = max(need, total)
+        with self._lock:
+            cap, _ = grow_chunk_cap(
+                need, self._bucket_cap, self.metrics,
+                min_bits=IMPACT_MIN_BUCKET_BITS)
+            cap = self._bucket_cap = max(self._bucket_cap, cap)
+        plans = []
+        for starts, lens, nb, total in runs:
+            cum = np.cumsum(nb) - nb
+            intra = np.arange(total, dtype=np.int64) - np.repeat(cum, nb)
+            b_start = np.zeros(cap, np.int32)
+            b_len = np.zeros(cap, np.int32)
+            b_row = np.zeros(cap, np.int32)
+            b_qw = np.zeros(cap, dtype)
+            b_start[:total] = (np.repeat(starts, nb)
+                               + W * intra).astype(np.int32)
+            b_len[:total] = np.minimum(
+                W, np.repeat(lens, nb) - W * intra).astype(np.int32)
+            b_row[:total] = np.repeat(rows, nb)
+            b_qw[:total] = np.repeat(qws, nb)
+            plans.append((b_start, b_len, b_row, b_qw, total))
+        return plans
+
     def _serve_group(self, ranker: str, misses: list[_Pending],
                      *, batch_size: int) -> None:
         """Dedup, pad, dispatch and resolve one ranker's share of a
-        micro-batch."""
+        micro-batch — across every live segment, merged on device."""
         # In-batch dedup: N copies of one hot query arriving inside a
         # single flush window dispatch ONCE (the cache can only serve
         # repeats across batches; this closes the within-batch gap).
@@ -629,37 +1035,75 @@ class TfidfServer:
             self._stats["cache_misses"] += len(uniq)
             self._stats["dedup_hits"] += len(misses) - len(uniq)
             self._stats["batches"] += 1
+            # the live set + per-segment prior operands + generation,
+            # read atomically: a refresh or set_prior landing mid-batch
+            # cannot smuggle this batch's result past its cache
+            # invalidation, and every segment of this batch scores under
+            # ONE prior generation (old buffers stay live for the
+            # in-flight dispatch — jax arrays are refcounted)
+            segs = list(self._segs)
+            priors = [s.prior_req if ranker == "prior" else s.prior
+                      for s in segs]
+            prior_gen = self._prior_gen
+            k = self.k
         obs.counter("serve.cache_misses", len(uniq))
 
         q = self.cfg.max_query_terms
         cap = batch_cap(len(uniq), self.cfg.max_batch, self.metrics)
-        with obs.span("serve.pad", size=len(uniq), cap=cap, ranker=ranker):
-            dtype = self.index.weight.dtype
-            q_term = np.zeros((cap, q), np.int32)
-            q_weight = np.zeros((cap, q), dtype)
-            q_valid = np.zeros((cap, q), dtype)
-            for i, p in enumerate(uniq):
-                m = min(p.q_term.shape[0], q)
-                q_term[i, :m] = p.q_term[:m]
-                q_weight[i, :m] = p.q_weight[:m]
-                q_valid[i, :m] = 1.0
+        impacted = self.cfg.scoring == "impacted"
+        dtype = segs[0].weights["tfidf"].dtype
+        with obs.span("serve.pad", size=len(uniq), cap=cap, ranker=ranker,
+                      segments=len(segs)):
+            if impacted:
+                plans = self._plan_impacted(segs, uniq, dtype)
+            else:
+                q_term = np.zeros((cap, q), np.int32)
+                q_weight = np.zeros((cap, q), dtype)
+                q_valid = np.zeros((cap, q), dtype)
+                for i, p in enumerate(uniq):
+                    m = min(p.q_term.shape[0], q)
+                    q_term[i, :m] = p.q_term[:m]
+                    q_weight[i, :m] = p.q_weight[:m]
+                    q_valid[i, :m] = 1.0
+
         # ranker="prior" is the tfidf table with the per-request prior
         # operand; tfidf/bm25 ride the every-request (rank_alpha) operand.
-        # The (operand, generation) pair is read atomically so a set_prior
-        # landing mid-batch cannot smuggle this batch's result past its
-        # cache invalidation.
-        table = self._weights["tfidf" if ranker == "prior" else ranker]
-        with self._lock:
-            prior = self._prior_req if ranker == "prior" else self._prior
-            prior_gen = self._prior_gen
-        try:
-            with obs.span("serve.dispatch", cap=cap, ranker=ranker):
-                scores_dev, idx_dev = rx.run_guarded(
-                    lambda: self._runner(
-                        *self._dev, table, self._valid,
+        def dispatch():
+            outs = []
+            for seg, prior, extra in zip(
+                    segs, priors, plans if impacted else segs):
+                table = seg.weights["tfidf" if ranker == "prior" else ranker]
+                if impacted:
+                    b_start, b_len, b_row, b_qw, _total = extra
+                    outs.append(ops.score_impacted_batch(
+                        seg.dev_doc, table, b_start, b_len, b_row, b_qw,
+                        prior, n_docs=seg.n_docs, batch=cap,
+                        bucket_width=self.cfg.impact_bucket_width,
+                        k=seg.k, use_prior=self._use_prior,
+                    ))
+                else:
+                    outs.append(ops.score_query_batch(
+                        seg.dev_doc, seg.dev_term, table, seg.valid,
                         q_term, q_weight, q_valid, prior,
-                    ),
-                    site="serve_dispatch", metrics=self.metrics,
+                        n_docs=seg.n_docs, vocab=self.vocab_size,
+                        k=seg.k, use_prior=self._use_prior,
+                    ))
+            if len(outs) == 1:
+                # single live segment: doc ids are already global (base
+                # 0) — byte-identical to the pre-segment serving path
+                return outs[0]
+            return ops.topk_merge(
+                tuple(o[0] for o in outs),
+                tuple(o[1] for o in outs),
+                tuple(s.doc_base for s in segs),
+                k=min(k, sum(s.k for s in segs)),
+            )
+
+        try:
+            with obs.span("serve.dispatch", cap=cap, ranker=ranker,
+                          segments=len(segs), scoring=self.cfg.scoring):
+                scores_dev, idx_dev = rx.run_guarded(
+                    dispatch, site="serve_dispatch", metrics=self.metrics,
                 )
             with obs.span("serve.pull", cap=cap):
                 # ONE batched [cap, k] pull — the only bytes that ever

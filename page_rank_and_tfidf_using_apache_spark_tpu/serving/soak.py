@@ -5,15 +5,21 @@ Everything the repo built separately finally runs AT THE SAME TIME, the
 way the "heavy traffic from millions of users" claim implies:
 
 - an **ingest thread** streams a growing synthetic corpus through the
-  staged ``chunked_ingest`` pipeline (``run_tfidf_streaming``) and commits
-  a fresh servable index version every ``rebuild_every_s`` — the
-  full-rebuild ingest→servable path the ROADMAP's delta-segments bullet
-  will later shorten;
-- the supervisor **hot-swaps** each new version under live traffic: the
-  replacement server is built and warmed *before* the flip, the old
-  server drains and fails its leftovers, and the closed-loop clients
-  retry — zero dropped, zero double-served (both *measured*, not
-  assumed);
+  staged ``chunked_ingest`` pipeline (``run_tfidf_streaming``) and — since
+  ISSUE 13 — seals each accumulated delta as an immutable **segment**
+  every ``rebuild_every_s`` (serving/segments.py ``seal_segment`` +
+  ``commit_append``).  Committed documents are NEVER re-streamed: the
+  full-rebuild path (re-ingest the whole accumulated corpus per version)
+  is retired, which also fixes the old arrivals-vs-reprocess accounting
+  wrinkle at its source — the pipeline now processes each chunk exactly
+  once, so arrivals == processed volume by construction;
+- the supervisor **hot-swaps** each new manifest generation onto the
+  RUNNING server (``TfidfServer.refresh_segments`` — warm first, swap
+  under the cache lock, no restart, no request dropped), and a background
+  :class:`~..serving.segments.SegmentMerger` compacts small segments under
+  the existing retry ladder; ``commit_to_servable_s`` — seal commit →
+  first query able to see the segment — is measured per swap and lands in
+  the SLO record (seconds, vs a full rebuild);
 - **closed-loop clients** drive mixed ``tfidf`` / ``bm25`` / ``prior``
   traffic (the per-request PageRank blend) at a target aggregate QPS;
 - a **prior-refresh thread** recomputes PageRank over the document graph
@@ -79,6 +85,9 @@ from page_rank_and_tfidf_using_apache_spark_tpu.obs.metrics import (
     TelemetrySink,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+    segments as sgm,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
     Bm25Config,
     PageRankConfig,
@@ -104,7 +113,7 @@ class SoakConfig:
     availability_target: float = 0.999  # * GRAFT_SOAK_SLO_AVAILABILITY
     clients: int = 3
     window_s: float = 60.0  # rolling SLO window
-    rebuild_every_s: float = 12.0  # ingest commit -> index version cadence
+    rebuild_every_s: float = 12.0  # delta-segment seal/commit cadence
     chunk_interval_s: float = 0.5  # corpus arrival pacing
     prior_refresh_every_s: float = 8.0
     losses: int = 1  # injected device losses (>=1 per the acceptance bar)
@@ -122,6 +131,14 @@ class SoakConfig:
     max_batch: int = 8
     prior_alpha: float = 0.25
     prior_iters: int = 5
+    scoring: str = "coo"  # serving path (byte-equal either way).  The
+    # soak's live set is tiny (thousands of docs), where the impacted
+    # path's padded bucket floor costs more than the full postings do —
+    # its win scales with corpus nnz (12.5x at 1M docs, bench
+    # --serve-scale).  "coo" here keeps the soak's p50 comparable across
+    # rounds; flip to "impacted" to soak the latency path itself.
+    max_live_segments: int = 4  # merge policy: compact beyond this
+    merge_interval_s: float = 2.0  # background merger cadence
     metrics_port: int | None = None  # None -> GRAFT_METRICS_PORT else 0
 
     def __post_init__(self) -> None:
@@ -202,7 +219,6 @@ class _Soak:
         self._stop = threading.Event()  # ingest + prior threads
         self._client_stop = threading.Event()
         self._failures: queue.Queue = queue.Queue()
-        self._versions: queue.Queue = queue.Queue()
         self._server: serving.TfidfServer | None = None
         self._chaos_ctx: chaos.inject | None = None
         self._outage = False
@@ -210,7 +226,8 @@ class _Soak:
         self._outage_first_fail: float | None = None
         self._recoveries: list[dict] = []
         self._unexpected: list[float] = []
-        self._rebuilds = 0
+        self._rebuilds = 0  # delta-segment seal commits (key kept from
+        # the full-rebuild era so rounds stay diffable)
         self._prior_refreshes = 0
         self._client_results: dict[int, list[dict]] = {}
         self._mid: dict | None = None
@@ -220,6 +237,13 @@ class _Soak:
         self._losses_fired = 0
         self._t0 = 0.0
         self._deadline = 0.0
+        # ---- delta-segment state (ISSUE 13) ----
+        self._docs_total = 0  # doc_base of the NEXT sealed segment
+        self._served_version = 0  # manifest generation the server serves
+        self._commit_times: dict[int, float] = {}  # version -> commit t
+        self._swaps: list[dict] = []  # per-refresh commit_to_servable_s
+        self._build_intervals: list[tuple[float, float]] = []  # seal spans
+        self._merger: sgm.SegmentMerger | None = None
         self.hub = MetricsHub(
             window_s=cfg.window_s,
             latency_slo_s=cfg.slo_p99_ms / 1e3,
@@ -238,9 +262,9 @@ class _Soak:
         )
 
     def _take_chunk(self, gen: Iterator[list[str]]) -> list[str]:
-        """Pull one arriving doc chunk, counting ARRIVALS — the rebuild
-        passes re-stream the whole accumulated corpus, so the pipeline's
-        own chunk events overcount ingested volume across rebuilds."""
+        """Pull one arriving doc chunk.  With delta segments each chunk
+        is streamed exactly once, so arrivals equal processed volume —
+        the counter stays as the record's ingest source of truth."""
         docs = next(gen)
         with self._lock:
             self._chunks_arrived += 1
@@ -249,17 +273,60 @@ class _Soak:
 
     # ------------------------------------------------------------ serving
 
+    def _seal_delta(self, delta: list[list[str]], scfg: TfidfConfig) -> int | None:
+        """Seal the accumulated delta docs as one immutable segment and
+        commit it live (the ingest→servable path: seconds, no rebuild).
+        Returns the committed manifest version, or None for an empty
+        delta.  The seal's wall span is recorded for the ingest-vs-serve
+        contention read-out."""
+        t0 = time.perf_counter()
+        with obs.span("soak.seal", chunks=len(delta)):
+            out = run_tfidf_streaming(iter(delta), scfg,
+                                      metrics=MetricsRecorder())
+            if out.n_docs < 1:
+                return None
+            with self._lock:
+                base = self._docs_total
+            # a neutral mean-1 prior placeholder; the prior-refresh
+            # thread hot-swaps a real global PageRank blend on cadence
+            ref = sgm.seal_segment(
+                self.index_dir, out, scfg, doc_base=base,
+                ranks=np.ones(out.n_docs, np.float32), bm25=Bm25Config(),
+            )
+            version = sgm.commit_append(self.index_dir, ref,
+                                        scfg.config_hash())
+            # the doc-id range is claimed only once the commit landed: a
+            # failed seal/commit retries the SAME base, so the global id
+            # space can never gap (a gap would wedge the merger's
+            # contiguity check and shift every later prior slice)
+            with self._lock:
+                self._docs_total = base + out.n_docs
+        now = time.perf_counter()
+        with self._lock:
+            self._rebuilds += 1
+            self._commit_times[version] = now
+            self._build_intervals.append((t0, now))
+        obs.emit("soak_seal", version=version, segment=ref.name,
+                 doc_base=base, n_docs=out.n_docs)
+        return version
+
     def _build_server(self) -> serving.TfidfServer:
-        """Load LATEST and stand up a fully-warmed replacement (compiles
-        happen HERE, before any flip — the live server keeps serving)."""
-        index = serving.load_index(self.index_dir)
+        """Load the committed segment set and stand up a fully-warmed
+        replacement (compiles happen HERE, before any flip — the live
+        server keeps serving).  Used at bootstrap and for device-loss
+        recovery; routine commits ride refresh_segments instead."""
+        segset = serving.load_segment_set(self.index_dir)
         scfg = serving.ServeConfig(
             top_k=self.cfg.top_k,
             max_batch=self.cfg.max_batch,
             queue_depth=max(64, 4 * self.cfg.max_batch),
             prior_alpha=self.cfg.prior_alpha,
+            scoring=self.cfg.scoring,
         )
-        return serving.TfidfServer(index, scfg).start()
+        srv = serving.TfidfServer(segset, scfg).start()
+        with self._lock:
+            self._served_version = segset.version
+        return srv
 
     def _swap_server(self, reason: str) -> None:
         new = self._build_server()
@@ -271,6 +338,40 @@ class _Soak:
             # leftover queued requests fail on stop; their clients retry
             # against the already-live replacement — served, not dropped
             old.stop()
+
+    def _maybe_refresh(self) -> None:
+        """Hot-swap the live server onto a newer committed manifest
+        generation (a seal commit or a background merge) WITHOUT restart,
+        measuring commit→servable per swap."""
+        ver = sgm.manifest_version(self.index_dir)
+        srv = self._server
+        if ver is None or srv is None:
+            return
+        with self._lock:
+            if ver == self._served_version:
+                return
+        segset = serving.load_segment_set(self.index_dir)
+        srv.refresh_segments(segset)
+        now = time.perf_counter()
+        with self._lock:
+            t_commit = self._commit_times.pop(segset.version, None)
+            # generations the swap skipped past (burst of commits) are
+            # served by this refresh too — drop their stale timestamps
+            for v in [v for v in self._commit_times if v < segset.version]:
+                self._commit_times.pop(v, None)
+            self._served_version = segset.version
+            self._swaps.append({
+                "version": segset.version,
+                "segments": len(segset.segments),
+                # merges carry no recorded commit time (the merger owns
+                # its own commit); seal commits measure end to end
+                "commit_to_servable_s": (
+                    round(now - t_commit, 3) if t_commit is not None
+                    else None
+                ),
+            })
+        obs.emit("soak_refresh", version=segset.version,
+                 segments=len(segset.segments), n_docs=segset.n_docs)
 
     # ------------------------------------------------------------- chaos
 
@@ -325,30 +426,30 @@ class _Soak:
 
     # ------------------------------------------------------------ threads
 
-    def _ingest_loop(self, gen: Iterator[list[str]],
-                     accum: list[list[str]]) -> None:
+    def _ingest_loop(self, gen: Iterator[list[str]]) -> None:
+        """Stream arrivals and seal each accumulated DELTA as a segment
+        on cadence.  Nothing is ever re-streamed: ``pending`` holds only
+        chunks that arrived since the last seal — the retired full-rebuild
+        path re-ingested the whole accumulated corpus every version, which
+        is also why its chunk accounting needed an arrivals-vs-reprocess
+        split; here processed == arrived by construction."""
         cfg = self.cfg
         scfg = self._stream_cfg()
-        next_rebuild = self._t0 + cfg.rebuild_every_s
+        pending: list[list[str]] = []
+        next_seal = self._t0 + cfg.rebuild_every_s
         while not self._stop.is_set():
-            accum.append(self._take_chunk(gen))
-            if time.perf_counter() >= next_rebuild:
-                with obs.span("soak.rebuild", chunks=len(accum)):
-                    out = run_tfidf_streaming(
-                        iter(list(accum)), scfg, metrics=MetricsRecorder()
-                    )
-                    ranks = _prior_ranks(out.n_docs, cfg.seed,
-                                         cfg.prior_iters)
-                    path = serving.save_index(
-                        self.index_dir, out, scfg, ranks=ranks,
-                        bm25=Bm25Config(),
-                    )
-                with self._lock:
-                    self._rebuilds += 1
-                obs.emit("soak_rebuild", version=os.path.basename(path),
-                         n_docs=out.n_docs, chunks=len(accum))
-                self._versions.put(path)
-                next_rebuild = time.perf_counter() + cfg.rebuild_every_s
+            pending.append(self._take_chunk(gen))
+            if time.perf_counter() >= next_seal and pending:
+                delta, pending = pending, []
+                try:
+                    self._seal_delta(delta, scfg)
+                except Exception as exc:  # noqa: BLE001 — a failed seal
+                    # must not kill ingest: the delta rejoins the queue
+                    # and the next tick retries it
+                    pending = delta + pending
+                    obs.emit("soak_seal_failed",
+                             error=f"{type(exc).__name__}: {exc}"[:160])
+                next_seal = time.perf_counter() + cfg.rebuild_every_s
             else:
                 self._stop.wait(cfg.chunk_interval_s)
 
@@ -422,6 +523,10 @@ class _Soak:
                         break
                     time.sleep(0.15)
             rec["e2e_s"] = time.perf_counter() - t_begin
+            # absolute span for the ingest-vs-serve contention read-out
+            # (_score buckets requests by overlap with seal-build spans)
+            rec["t_begin"] = t_begin
+            rec["t_end"] = time.perf_counter()
             results.append(rec)
 
     # --------------------------------------------------------- supervisor
@@ -488,17 +593,21 @@ class _Soak:
         exporter = MetricsExporter(self.hub, port=port).start()
         gen = _doc_chunks(cfg)
         try:
-            # ---- bootstrap: first index version + first warm server ----
+            # ---- bootstrap: first sealed segment + first warm server ----
             with obs.span("soak.bootstrap"):
-                accum = [self._take_chunk(gen)
-                         for _ in range(cfg.bootstrap_chunks)]
+                boot = [self._take_chunk(gen)
+                        for _ in range(cfg.bootstrap_chunks)]
                 scfg = self._stream_cfg()
-                out = run_tfidf_streaming(iter(list(accum)), scfg,
-                                          metrics=MetricsRecorder())
-                ranks = _prior_ranks(out.n_docs, cfg.seed, cfg.prior_iters)
-                serving.save_index(self.index_dir, out, scfg, ranks=ranks,
-                                   bm25=Bm25Config())
+                self._seal_delta(boot, scfg)
                 self._server = self._build_server()
+                ranks = _prior_ranks(self._server.index.n_docs, cfg.seed,
+                                     cfg.prior_iters)
+                self._server.set_prior(ranks)
+            self._merger = sgm.SegmentMerger(
+                self.index_dir, scfg,
+                max_segments=cfg.max_live_segments,
+                interval_s=cfg.merge_interval_s,
+            ).start()
             self._t0 = time.perf_counter()
             self._deadline = self._t0 + cfg.duration_s
             obs.emit("soak_start", duration_s=cfg.duration_s, qps=cfg.qps,
@@ -517,7 +626,7 @@ class _Soak:
 
             threads = [
                 threading.Thread(target=self._ingest_loop,
-                                 args=(gen, accum), name="soak-ingest",
+                                 args=(gen,), name="soak-ingest",
                                  daemon=True),
                 threading.Thread(target=self._prior_loop,
                                  name="soak-prior", daemon=True),
@@ -564,14 +673,16 @@ class _Soak:
                         if len(recent) >= 3:
                             self._unexpected = []
                             self._recover("unexpected", anchor=recent[0])
-                swap_to = None
-                while True:  # newest committed version wins
+                if not self._outage:
+                    # a newer committed manifest (seal or merge) hot-swaps
+                    # onto the RUNNING server — no rebuild, no restart
                     try:
-                        swap_to = self._versions.get_nowait()
-                    except queue.Empty:
-                        break
-                if swap_to is not None and not self._outage:
-                    self._swap_server(reason="rebuild")
+                        self._maybe_refresh()
+                    except Exception as exc:  # noqa: BLE001 — a failed
+                        # refresh leaves the previous set serving; the
+                        # next supervisor tick retries the load/swap
+                        obs.emit("soak_refresh_failed",
+                                 error=f"{type(exc).__name__}: {exc}"[:160])
                 self._maybe_mid_snapshot(exporter, now_s)
 
             actual_s = time.perf_counter() - self._t0
@@ -585,6 +696,8 @@ class _Soak:
         finally:
             self._stop.set()
             self._client_stop.set()
+            if self._merger is not None:
+                self._merger.stop()
             with self._lock:
                 ctx, self._chaos_ctx = self._chaos_ctx, None
             if ctx is not None:
@@ -609,6 +722,9 @@ class _Soak:
             chunks_arrived = self._chunks_arrived
             tokens_arrived = self._tokens_arrived
             mid = self._mid or self._mid_error
+            swaps = list(self._swaps)
+            build_ivs = list(self._build_intervals)
+            served_version = self._served_version
         recs = [r for results in per_client.values() for r in results]
         dropped = 0
         double_served = 0
@@ -627,6 +743,33 @@ class _Soak:
             if r["ok"]:
                 e2e_ok.append(r["e2e_s"])
         e2e_ok.sort()
+
+        # ---- ingest-vs-serve contention (the PR-11 remaining note, now
+        # measured): client e2e latency bucketed by whether the request
+        # overlapped a seal-build span — the "before" of this read-out is
+        # the full-rebuild era's whole-corpus re-stream per version; the
+        # delta seals shrink both the spans and the work inside them ----
+        during: list[float] = []
+        idle: list[float] = []
+        for r in recs:
+            if not r["ok"] or "t_begin" not in r:
+                continue
+            overlapped = any(r["t_begin"] < b and r["t_end"] > a
+                             for a, b in build_ivs)
+            (during if overlapped else idle).append(r["e2e_s"])
+        during.sort()
+        idle.sort()
+        contention = {
+            "during_ingest_requests": len(during),
+            "during_ingest_p99_ms": _ms(percentile(during, 0.99)),
+            "idle_requests": len(idle),
+            "idle_p99_ms": _ms(percentile(idle, 0.99)),
+            "ingest_busy_frac": round(
+                sum(b - a for a, b in build_ivs) / max(actual_s, 1e-9), 4
+            ),
+        }
+        c2s = [s["commit_to_servable_s"] for s in swaps
+               if s.get("commit_to_servable_s") is not None]
 
         snap = self.hub.snapshot()
         win = snap["latency_s"]["window"]
@@ -665,15 +808,32 @@ class _Soak:
             "dropped": dropped,
             "double_served": double_served,
             "ingest": {
-                # ARRIVAL counts — the rebuild passes re-stream the whole
-                # accumulated corpus, so the pipeline's own chunk events
-                # (the hub's ingest.* counters) overcount volume
+                # arrivals == processed volume now: the delta-segment path
+                # streams each chunk exactly ONCE (the full-rebuild era
+                # re-streamed the accumulated corpus per version, which is
+                # why this used to need an arrivals-vs-reprocess split)
                 "chunks": chunks_arrived,
                 "tokens": tokens_arrived,
-                "rebuilds": rebuilds,
+                "mode": "segments",
+                "rebuilds": rebuilds,  # delta-segment seal commits
+                "merges": self._merger.merges if self._merger else 0,
+                "live_segments": (
+                    len(m.segments)
+                    if (m := sgm.latest_manifest(self.index_dir)) else 0
+                ),
                 "prior_refreshes": prior_refreshes,
                 "index_version": version,
+                "served_version": served_version,
+                # seal commit -> segment servable on the RUNNING server,
+                # per hot-swap (the acceptance bar: seconds, not rebuild)
+                "commit_to_servable_s": {
+                    "max": max(c2s) if c2s else None,
+                    "mean": (round(sum(c2s) / len(c2s), 3)
+                             if c2s else None),
+                    "swaps": len(swaps),
+                },
             },
+            "contention": contention,
             "chaos_injections": _ctr("chaos.injections"),
             "chaos_losses": _ctr("chaos.losses"),
             "mixed_traffic": mixed,
@@ -690,12 +850,16 @@ class _Soak:
 
 
 def serving_latest_version(index_dir: str) -> int | None:
-    """Version number behind the LATEST pointer (None when no version
-    has committed yet)."""
+    """Version number behind the LATEST pointer — the manifest generation
+    for a segmented directory, the array-dir version for a plain one
+    (None when nothing has committed yet)."""
     from page_rank_and_tfidf_using_apache_spark_tpu.utils import (
         checkpoint as ckpt,
     )
 
+    ver = sgm.manifest_version(index_dir)
+    if ver is not None:
+        return ver
     path = ckpt.latest_array_dir(index_dir)
     if path is None:
         return None

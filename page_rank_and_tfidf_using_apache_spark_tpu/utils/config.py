@@ -62,6 +62,9 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         "GRAFT_SOAK_SLO_AVAILABILITY",  # soak SLO target: good-request
         # fraction the availability error budget is scored against
         # (default 0.999)
+        "GRAFT_SEG_BUDGET_S",  # tools/ci.sh wall-clock budget for the
+        # segment-smoke gate (ingest → seal → query-from-new-segment →
+        # merge under chaos; read in bash; default 15s)
     }
 )
 
@@ -123,9 +126,17 @@ THREAD_REGISTRY: tuple = (
      # cache + stats; NEVER _submit_lock (the drain must keep consuming
      # while a submitter blocks on the bounded queue holding it)
      ("TfidfServer._lock",)),
+    ("segment-merge",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/segments.py",
+     # background compaction: its own stats lock + the module commit lock
+     # serializing manifest read-modify-write against ingest seals
+     ("SegmentMerger._lock", "_COMMIT_LOCK")),
     ("soak-ingest",
      "page_rank_and_tfidf_using_apache_spark_tpu/serving/soak.py",
-     ("_Soak._lock",)),
+     ("_Soak._lock",
+      # delta-segment commits go through the segments module commit lock
+      "page_rank_and_tfidf_using_apache_spark_tpu/serving/segments.py::"
+      "_COMMIT_LOCK")),
     ("soak-prior",
      "page_rank_and_tfidf_using_apache_spark_tpu/serving/soak.py",
      ("_Soak._lock",)),

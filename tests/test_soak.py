@@ -224,6 +224,51 @@ def test_trace_diff_slo_jitter_floor(tmp_path):
     assert td.main([old, new, "--threshold", "0.35"]) == 0
 
 
+def test_trace_diff_served_p99_regression_fails(tmp_path, capsys):
+    """The ISSUE 13 served-latency gate: a batch size's served p99
+    regressing past the threshold fails the diff like an SLO breach."""
+    td = _tool("trace_diff")
+    old = _bench_record(tmp_path / "BENCH_r01.json", None)
+    new = _bench_record(tmp_path / "BENCH_r02.json", None)
+    for path, p99 in ((old, 20.0), (new, 90.0)):
+        rec = json.loads(Path(path).read_text())
+        rec["extra"]["served_p99_ms"] = {"b8": p99, "b16": 10.0}
+        Path(path).write_text(json.dumps(rec))
+    rc = td.main([old, new, "--threshold", "0.35"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "served.b8.p99_ms" in out and "REGRESSED" in out
+
+
+def test_trace_diff_served_jitter_and_fallback(tmp_path):
+    """Jitter-floor deltas pass, and the old-round fallback reads the
+    per-batch p99 out of extra.served_qps (r07/r08 rounds), so the gate
+    arms on the FIRST round that writes the flat maps."""
+    td = _tool("trace_diff")
+    old = _bench_record(tmp_path / "BENCH_r01.json", None)
+    new = _bench_record(tmp_path / "BENCH_r02.json", None)
+    rec = json.loads(Path(old).read_text())
+    rec["extra"]["served_qps"] = {"b8": {"qps": 50.0, "p99_ms": 1.0}}
+    Path(old).write_text(json.dumps(rec))
+    rec = json.loads(Path(new).read_text())
+    rec["extra"]["served_p99_ms"] = {"b8": 2.5}  # 2.5x but 1.5ms: jitter
+    Path(new).write_text(json.dumps(rec))
+    assert td.main([old, new, "--threshold", "0.35"]) == 0
+    rec["extra"]["served_p99_ms"] = {"b8": 40.0}  # real regression
+    Path(new).write_text(json.dumps(rec))
+    assert td.main([old, new, "--threshold", "0.35"]) == 1
+
+
+def test_trace_diff_served_numbers_vanishing_fails(tmp_path):
+    td = _tool("trace_diff")
+    old = _bench_record(tmp_path / "BENCH_r01.json", None)
+    new = _bench_record(tmp_path / "BENCH_r02.json", None)
+    rec = json.loads(Path(old).read_text())
+    rec["extra"]["served_p99_ms"] = {"b8": 20.0}
+    Path(old).write_text(json.dumps(rec))
+    assert td.main([old, new, "--threshold", "0.35"]) == 1
+
+
 def test_trace_diff_slo_absent_on_old_round_is_not_a_regression(tmp_path):
     """r08 and earlier carry no SLO record: the first SLO-carrying round
     must not fail the gate against them — but LOSING the record once the

@@ -174,6 +174,71 @@ print("soak smoke: OK "
       f"{rec['ingest']['rebuilds']} rebuild(s))")
 EOF
 
+echo "== segment smoke (seal → serve → post-start commit → merge under *:fail@%5, budget ${GRAFT_SEG_BUDGET_S:-15}s) =="
+# The ISSUE 13 ingest→servable path as a bounded CI gate: seal a delta
+# segment, serve it via impacted-list scoring, commit a SECOND segment
+# AFTER server start and hot-swap it live (no restart — the acceptance
+# bar), then background-merge the set — all under transient chaos.  The
+# whole lifecycle must fit GRAFT_SEG_BUDGET_S ("servable in seconds").
+t0=$(date +%s)
+if ! env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    GRAFT_CHAOS='*:fail@%5' GRAFT_RETRY_MAX=4 GRAFT_BACKOFF_BASE_S=0.01 \
+    SEG_SMOKE_DIR="$smoke_dir" \
+    python - > "$smoke_dir/segments.log" 2>&1 <<'EOF'
+import os
+import numpy as np
+from page_rank_and_tfidf_using_apache_spark_tpu import serving
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+    run_tfidf_streaming,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import segments as sgm
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+
+d = os.path.join(os.environ["SEG_SMOKE_DIR"], "segidx")
+scfg = TfidfConfig(vocab_bits=8, prefetch=0, pipeline_depth=0)
+chunks = [[f"tok{i} tok{i % 5} shared word" for i in range(j * 3, j * 3 + 3)]
+          for j in range(4)]
+out = run_tfidf_streaming(iter(chunks), scfg)
+ref = sgm.seal_segment(d, out, scfg, doc_base=0)
+sgm.commit_append(d, ref, scfg.config_hash())
+srv = serving.TfidfServer(
+    sgm.load_segment_set(d),
+    serving.ServeConfig(top_k=3, scoring="impacted"),
+).start()
+s, _ = srv.query(["tok3"])
+assert float(s[0]) > 0
+# a segment committed AFTER server start, hot-swapped without restart
+out2 = run_tfidf_streaming(iter([["freshterm post start doc"]]), scfg)
+ref2 = sgm.seal_segment(d, out2, scfg, doc_base=out.n_docs)
+sgm.commit_append(d, ref2, scfg.config_hash())
+srv.refresh_segments(sgm.load_segment_set(d))
+s2, i2 = srv.query(["freshterm"])
+assert float(s2[0]) > 0 and int(i2[0]) == out.n_docs, (s2, i2)
+# background compaction down to one segment, still serving the same doc
+merger = sgm.SegmentMerger(d, scfg, max_segments=1)
+while merger.merge_once():
+    pass
+assert len(sgm.latest_manifest(d).segments) == 1
+srv.refresh_segments(sgm.load_segment_set(d))
+s3, i3 = srv.query(["freshterm"])
+assert int(i3[0]) == int(i2[0])
+srv.stop()
+print("segment smoke: OK — post-start commit served from segment "
+      f"{ref2.name} (global doc {int(i2[0])}), merged to 1 segment")
+EOF
+then
+    echo "FAIL: segment smoke; its output:" >&2
+    cat "$smoke_dir/segments.log" >&2
+    exit 1
+fi
+tail -1 "$smoke_dir/segments.log"
+dt=$(( $(date +%s) - t0 ))
+echo "segment smoke: ${dt}s"
+if [ "$dt" -gt "${GRAFT_SEG_BUDGET_S:-15}" ]; then
+    echo "FAIL: segment smoke exceeded its ${GRAFT_SEG_BUDGET_S:-15}s budget (${dt}s) — the ingest→servable path stopped being 'seconds'" >&2
+    exit 1
+fi
+
 echo "== chaos gate (tier-1 under *:fail@%5 + device_lost mesh-shrink scenario) =="
 # chaos.sh's second half runs the device_lost sharded scenario under
 # XLA_FLAGS=--xla_force_host_platform_device_count=2: both sharded runners

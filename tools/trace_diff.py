@@ -19,6 +19,12 @@ side channel.  Rounds are only compared when BOTH carry an SLO record,
 except that a new round *losing* its record while the old one had one is
 itself flagged (the bench lost its SLO accounting).
 
+Since ISSUE 13 the same discipline covers the serve bench's **per-batch
+served latency** (``extra.served_p99_ms``, falling back to the p99
+blocks inside ``extra.served_qps`` for older rounds): a batch size whose
+served p99 regressed past ``--threshold`` (over the same jitter floor)
+fails the diff, and a round losing its served numbers is flagged.
+
 Stdlib-only (importable from the jax-free bench parent, same rule as
 trace_report.py).
 
@@ -122,6 +128,67 @@ def load_slo(path: str) -> dict | None:
 # Minimum absolute p99 delta (ms) an SLO regression must also clear — a
 # CPU-backend soak's p99 jitters by single-digit milliseconds run to run.
 SLO_MIN_DELTA_MS = 2.0
+
+
+def load_served_p99(path: str) -> dict | None:
+    """Per-batch served p99 map (``{"b8": ms, ...}``) riding a BENCH
+    round: ``extra.served_p99_ms`` since ISSUE 13, with a fallback to the
+    per-batch blocks inside ``extra.served_qps`` for older rounds (r07+),
+    so the gate arms on the first new round.  None when the artifact
+    carries no served numbers (raw traces, pre-serving rounds, failed
+    serve child)."""
+    if path.endswith(".jsonl"):
+        return None
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    extra = record.get("extra", {})
+    p99 = extra.get("served_p99_ms")
+    if isinstance(p99, dict) and p99:
+        return {k: float(v) for k, v in p99.items() if v is not None}
+    served = extra.get("served_qps")
+    if isinstance(served, dict):
+        out = {
+            b: float(v["p99_ms"]) for b, v in served.items()
+            if isinstance(v, dict) and v.get("p99_ms") is not None
+        }
+        return out or None
+    return None
+
+
+def diff_served(
+    old: dict | None, new: dict | None, threshold: float
+) -> list[dict]:
+    """Served-latency regression rows, mirroring the SLO p99 gate: a
+    batch size's p99 regresses RELATIVELY past ``threshold`` (and past
+    the jitter floor); a round LOSING its served numbers while the old
+    one had them is itself flagged.  Batch sizes present on only one
+    side (a changed matrix) are attribution, not regression."""
+    if old is None:
+        return []
+    if new is None:
+        return [{
+            "key": "served.missing",
+            "old": "present",
+            "new": None,
+            "why": "the old round carried served p50/p99 numbers and the "
+                   "new one does not — the round lost its serve bench",
+        }]
+    rows: list[dict] = []
+    for b in sorted(set(old) & set(new)):
+        o, n = old[b], new[b]
+        if n > o * (1.0 + threshold) and n - o > SLO_MIN_DELTA_MS:
+            rows.append({
+                "key": f"served.{b}.p99_ms",
+                "old": o,
+                "new": n,
+                "why": f"served p99 at {b} grew {n / max(o, 1e-9):.2f}x",
+            })
+    return rows
 
 
 def diff_slo(
@@ -235,14 +302,19 @@ def main(argv: list[str] | None = None) -> int:
     # must not silently pass the SLO gate.
     slo_rows = diff_slo(load_slo(args.old), load_slo(args.new),
                         args.threshold)
+    served_rows = diff_served(load_served_p99(args.old),
+                              load_served_p99(args.new), args.threshold)
     all_regressions = (
-        [r["phase"] for r in regressions] + [r["key"] for r in slo_rows]
+        [r["phase"] for r in regressions]
+        + [r["key"] for r in slo_rows]
+        + [r["key"] for r in served_rows]
     )
     result = {
         "old": {"path": args.old, "kind": old_kind, "wall_secs": old_wall},
         "new": {"path": args.new, "kind": new_kind, "wall_secs": new_wall},
         "phases": rows,
         "slo": slo_rows,
+        "served": served_rows,
         "regressions": all_regressions,
         "worst_regression": all_regressions[0] if all_regressions else None,
     }
@@ -262,7 +334,7 @@ def main(argv: list[str] | None = None) -> int:
             mark = " <-- REGRESSED" if r["phase"] in result["regressions"] else ""
             print(f"{r['phase']:28s} {r['old_secs']:9.3f} {r['new_secs']:9.3f} "
                   f"{r['delta_secs']:+9.3f}  {rel}{mark}")
-        for r in slo_rows:
+        for r in slo_rows + served_rows:
             print(f"{r['key']:28s} {r['old']!s:>9s} {r['new']!s:>9s}  "
                   f"{r['why']} <-- REGRESSED")
         if all_regressions:
