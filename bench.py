@@ -988,11 +988,11 @@ def _read_ckpt_meta(ck_dir: str) -> dict | None:
 
 
 def _lint_clean() -> bool | None:
-    """Run the graftlint gate (all four tiers — lexical, semantic, cost,
-    concurrency — in a CPU-only subprocess) and report its verdict, so
-    every BENCH_*.json records whether the measured tree passed static
-    analysis.  None = the gate itself could not run (never blocks the
-    bench)."""
+    """Run the graftlint gate (all five tiers — lexical, semantic, cost,
+    concurrency, persistence — in a CPU-only subprocess) and report its
+    verdict, so every BENCH_*.json records whether the measured tree
+    passed static analysis.  None = the gate itself could not run (never
+    blocks the bench)."""
     lint_sh = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "tools", "lint.sh")
     try:

@@ -1,6 +1,7 @@
-"""graftlint — JAX/TPU-aware static analysis for this codebase (ISSUEs 1, 3).
+"""graftlint — JAX/TPU-aware static analysis for this codebase (ISSUEs 1,
+3, 6, 12, 14).
 
-Two tiers over one ratchet baseline:
+Five tiers over one ratchet baseline:
 
 - **Tier 1 (lexical, rules.py)**: stdlib-only AST rules over the package,
   ``tools/`` and ``bench.py`` — hot loops stay inside one compiled program
@@ -15,10 +16,24 @@ Two tiers over one ratchet baseline:
   declared shape matrix, 64-bit promotion under x64, host callbacks per
   compiled step, and collective axes/volume against the declared mesh
   contract.
+- **Tier 3 (cost, cost.py)**: the static FLOP/byte model over the same
+  traces — intensity floors (advisory while the cost artifacts are
+  CPU-stamped), pad_frac budgets over the partition/padding plans, and
+  the buffer-donation verifier against the lowered aliasing.
+- **Tier 4 (concurrency, concurrency.py)**: stdlib-only interprocedural
+  analysis of the threaded runtime — lock-order cycles,
+  blocking-under-lock, use-after-donate over the ``DONATED_CALLEES``
+  contract, chaos-coverage drift, thread/lock registry drift.
+- **Tier 5 (persistence, persistence.py)**: stdlib-only crash-window
+  analysis of every on-disk protocol — atomic-write drift, pointer-flip
+  ordering, generation-deferred GC, writer/reader drift against
+  ``ARTIFACT_SCHEMAS``, commit-lock drift against ``COMMIT_LOCKS`` — and
+  the crash-point enumeration ``tools/crash_harness.py`` replays with
+  SIGKILLs.
 
-Both tiers report through ``analysis/baseline.json`` (kept empty: fix true
-positives, don't freeze them) and fail CI via ``tools/lint.sh`` /
-``tests/test_graftlint.py`` / ``tests/test_semantic_lint.py``.
+All tiers report through ``analysis/baseline.json`` (kept empty: fix true
+positives, don't freeze them) and fail CI via ``tools/lint.sh`` and the
+per-tier test files under ``tests/``.
 """
 
 from page_rank_and_tfidf_using_apache_spark_tpu.analysis.engine import (

@@ -3,9 +3,10 @@
 Usage::
 
     python -m page_rank_and_tfidf_using_apache_spark_tpu.analysis \
-        [paths...] [--tier 1|2|3|4|all] [--changed-only [BASE]] [--json] \
+        [paths...] [--tier 1|2|3|4|5|all] [--changed-only [BASE]] [--json] \
         [--baseline FILE | --no-baseline] [--write-baseline] \
-        [--cost-report] [--lock-graph] [--list-rules] [--list-entry-points]
+        [--cost-report] [--lock-graph] [--crash-points] [--list-rules] \
+        [--list-entry-points]
 
 Tier 1 is the lexical AST rule set (stdlib-only; runs even when jax is
 broken).  Tier 2 traces the registered jit entry points on the CPU backend
@@ -19,17 +20,24 @@ like tier 1): lock-order cycles, blocking calls under locks,
 use-after-donate dataflow against the registry's donation-liveness
 contract, chaos-coverage drift over the guarded sites, and thread/lock
 drift against utils/config.py THREAD_REGISTRY; ``--lock-graph`` emits its
-lock-acquisition graph as DOT (JSON under ``--json``).  Tiers 2 and 3
-need an importable jax.  All tiers report through the same ratchet
-baseline; tier-3 advisories are printed but never gate.
+lock-acquisition graph as DOT (JSON under ``--json``).  Tier 5 is the
+persistence & crash-consistency analyzer (stdlib-only like tiers 1/4):
+atomic-write drift, pointer-flip ordering, generation-deferred GC,
+writer/reader schema drift against ``analysis/registry.py``
+``ARTIFACT_SCHEMAS``, and commit-lock drift against ``COMMIT_LOCKS``;
+``--crash-points`` prints its enumeration of every write boundary in the
+declared commit sequences (what ``tools/crash_harness.py`` replays with
+SIGKILLs).  Tiers 2 and 3 need an importable jax.  All tiers report
+through the same ratchet baseline; tier-3 advisories are printed but
+never gate.
 
-With no paths, tiers 1/4 scan the tier-1 surface (the package, ``tools/``
-and ``bench.py``) and tiers 2/3 cover every registered entry point.  With
-explicit paths (or ``--changed-only``), tier 1 scans those files, tiers
-2/3 run only the entries whose contracted module is among them, and tier 4
-still models the whole surface but reports only findings in those files —
-unless an ``analysis/`` file itself changed, which re-verifies every
-contract.
+With no paths, tiers 1/4/5 scan the tier-1 surface (the package,
+``tools/`` and ``bench.py``) and tiers 2/3 cover every registered entry
+point.  With explicit paths (or ``--changed-only``), tier 1 scans those
+files, tiers 2/3 run only the entries whose contracted module is among
+them, and tiers 4/5 still model the whole surface but report only
+findings in those files — unless an ``analysis/`` file itself changed,
+which re-verifies every contract.
 
 Exit codes: 0 = no findings beyond the ratchet baseline, 1 = new findings
 (printed), 2 = bad invocation.
@@ -63,12 +71,13 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to scan (default: package + tools + bench.py)")
-    ap.add_argument("--tier", choices=("1", "2", "3", "4", "all"),
+    ap.add_argument("--tier", choices=("1", "2", "3", "4", "5", "all"),
                     default="all",
                     help="1 = lexical rules, 2 = semantic (jaxpr) checks, "
                          "3 = static cost model (intensity/pad_frac/"
                          "donation), 4 = interprocedural concurrency & "
-                         "buffer-lifetime analysis, all = every tier "
+                         "buffer-lifetime analysis, 5 = persistence & "
+                         "crash-consistency analysis, all = every tier "
                          "(default)")
     ap.add_argument("--cost-report", action="store_true",
                     help="print the tier-3 per-entry cost table as JSON "
@@ -77,6 +86,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="emit the tier-4 lock-acquisition graph as DOT "
                          "(embedded as JSON under --json); implies the "
                          "tier-4 analysis ran")
+    ap.add_argument("--crash-points", action="store_true",
+                    help="print the tier-5 crash-point enumeration (every "
+                         "write boundary of the declared commit sequences) "
+                         "as JSON; implies the tier-5 analysis ran")
     ap.add_argument("--changed-only", nargs="?", const="HEAD", default=None,
                     metavar="BASE",
                     help="lint only files changed vs BASE (default HEAD): "
@@ -103,6 +116,9 @@ def main(argv: list[str] | None = None) -> int:
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.cost import (
             COST_RULES,
         )
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis.persistence import (
+            PERSIST_RULES,
+        )
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.semantic import (
             SEMANTIC_RULES,
         )
@@ -113,6 +129,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{rid:22s} [tier 3] {summary}")
         for rid, summary in CONC_RULES.items():
             print(f"{rid:22s} [tier 4] {summary}")
+        for rid, summary in PERSIST_RULES.items():
+            print(f"{rid:22s} [tier 5] {summary}")
         return 0
 
     if args.list_entry_points:
@@ -134,6 +152,7 @@ def main(argv: list[str] | None = None) -> int:
     tier2 = args.tier in ("2", "all")
     tier3 = args.tier in ("3", "all") or args.cost_report
     tier4 = args.tier in ("4", "all") or args.lock_graph
+    tier5 = args.tier in ("5", "all") or args.crash_points
 
     if args.changed_only is not None and args.paths:
         print("graftlint: give either paths or --changed-only, not both",
@@ -242,6 +261,28 @@ def main(argv: list[str] | None = None) -> int:
             )
         lock_graph = cc.graph
 
+    crash_points = None
+    if tier5:
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis import (
+            persistence,
+        )
+
+        # like tier 4: always model the full surface; a restricted run
+        # only filters which files may report findings.  One model build
+        # serves both the findings pass and the crash-point enumeration
+        # (the GRAFT_PERSIST_BUDGET_S ci gate times this invocation).
+        pmodels = persistence.build_models(root)
+        pres = persistence.run_persistence(root=root,
+                                           only_modules=only_modules,
+                                           models=pmodels)
+        if pres.findings:
+            findings = engine.assign_fingerprints(
+                list(findings) + pres.findings
+            )
+        if args.crash_points:
+            crash_points = persistence.crash_point_report(root,
+                                                          models=pmodels)
+
     if tier2 or tier3:
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.registry import (
             ENTRY_POINTS,
@@ -282,6 +323,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.lock_graph and lock_graph is not None and not args.json:
         print(lock_graph.to_dot())
 
+    if args.crash_points and crash_points is not None and not args.json:
+        import json as _json
+
+        print(_json.dumps(crash_points, indent=2))
+
     if args.json:
         extra_json = {}
         if advisories:
@@ -290,6 +336,8 @@ def main(argv: list[str] | None = None) -> int:
             extra_json["cost_report"] = cost_report
         if args.lock_graph and lock_graph is not None:
             extra_json["lock_graph"] = lock_graph.to_json()
+        if args.crash_points and crash_points is not None:
+            extra_json["crash_points"] = crash_points
         print(
             render_json(
                 result.new,

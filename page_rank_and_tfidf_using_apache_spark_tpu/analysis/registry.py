@@ -133,6 +133,101 @@ DONATED_CALLEES: tuple = (
     )),
 )
 
+# ---------------------------------------------------------------------------
+# Persistence contracts (tier 5, ISSUE 14).
+#
+# ``ARTIFACT_SCHEMAS`` declares every on-disk artifact family the runtime
+# commits and reloads — the serving index array-dir, the segment manifest,
+# checkpoint metadata, the run manifest, the measured cost artifacts — in
+# the same two-way contract style as ``DONATED_CALLEES``: the lexical
+# surface (which keys writers store, which keys readers load) and the
+# declaration may not drift apart in either direction.
+#
+# Each row is ``(family, writers, readers, keys, aux_keys)``:
+#
+# - ``writers`` / ``readers`` are ``"<repo-relative path>::<function>"``
+#   specs (``Class.method`` allowed for the function part; readers may
+#   append ``::<receiver>`` to scope collection to one dict variable —
+#   needed for reader modules like tools/trace_report.py that handle many
+#   document shapes in one function);
+# - ``keys`` is the family's full declared key space: array members plus
+#   META/JSON document keys;
+# - ``aux_keys`` (a subset of ``keys``) marks deliberately write-only
+#   forensic keys — evidence for humans/ops tooling that no code path
+#   loads back (the run manifest's argv/knob snapshot, the index META's
+#   corpus stats).
+#
+# The tier-5 ``schema-pair-drift`` check (analysis/persistence.py)
+# validates both directions: every declared key must be written by a
+# writer; every non-aux key must be read by a reader (a member saved but
+# never loaded — or loaded but never saved — is a finding); every lexical
+# write/read of an undeclared key is drift.  Parsed lexically — keep it a
+# literal.
+ARTIFACT_SCHEMAS: tuple = (
+    ("index",
+     (f"{_PKG}/serving/artifact.py::save_index",
+      f"{_PKG}/serving/segments.py::seal_segment",
+      f"{_PKG}/serving/segments.py::merge_segments"),
+     (f"{_PKG}/serving/artifact.py::load_index",
+      f"{_PKG}/serving/segments.py::load_segment_set",
+      f"{_PKG}/serving/segments.py::merge_segments"),
+     ("doc", "term", "weight", "idf", "df", "term_offsets", "count",
+      "doc_lengths", "ranks", "bm25_weight",
+      "format", "n_docs", "vocab_bits", "nnz", "has_ranks", "has_bm25",
+      "bm25_config", "tfidf_config", "doc_base", "merged_from"),
+     # corpus stats + provenance: ops-facing META evidence; the reader
+     # side reconstructs them from SegmentRef/arrays instead
+     ("nnz", "has_ranks", "has_bm25", "doc_base", "merged_from")),
+    ("segment_manifest",
+     (f"{_PKG}/serving/segments.py::_write_manifest",
+      f"{_PKG}/serving/segments.py::SegmentRef.to_json"),
+     (f"{_PKG}/serving/segments.py::latest_manifest",
+      f"{_PKG}/serving/segments.py::_replaced_by",
+      f"{_PKG}/serving/segments.py::SegmentRef.from_json"),
+     ("version", "config_hash", "n_docs", "nnz", "replaced", "segments",
+      "name", "doc_base"),
+     ()),
+    ("checkpoint_meta",
+     (f"{_PKG}/utils/checkpoint.py::save_checkpoint",
+      f"{_PKG}/utils/checkpoint.py::save_array_dir"),
+     (f"{_PKG}/utils/checkpoint.py::load_checkpoint",
+      f"{_PKG}/utils/checkpoint.py::load_array_dir"),
+     ("step", "config_hash", "extra"),
+     ()),
+    ("run_manifest",
+     (f"{_PKG}/obs/manifest.py::write_manifest",
+      f"{_PKG}/obs/manifest.py::finalize_manifest",
+      f"{_PKG}/obs/manifest.py::_device_snapshot"),
+     ("tools/trace_report.py::stitch::man",
+      "tools/trace_report.py::render_human::man"),
+     ("name", "status", "pid", "argv", "python", "started_wall",
+      "trace_path", "git_sha", "lint_clean", "knobs", "backend", "devices",
+      "device_count", "finished_wall", "wall_secs", "events", "summary"),
+     # the SIGKILL-forensics payload: written for humans reading the file,
+     # not reloaded by any code path
+     ("argv", "python", "started_wall", "trace_path", "lint_clean",
+      "knobs", "devices", "device_count", "finished_wall", "wall_secs",
+      "events", "summary")),
+    ("cost_artifact",
+     (f"{_PKG}/utils/artifacts.py::write_artifact",),
+     (f"{_PKG}/utils/artifacts.py::read_backend",),
+     ("backend",),
+     ()),
+)
+
+# ``COMMIT_LOCKS`` declares which lock serializes each on-disk protocol's
+# read-modify-write commit step: ``(module, lock spelled as acquired,
+# protected callee leaves)``.  The tier-5 ``commit-lock-drift`` check
+# requires every lexical call to a protected callee in that module to sit
+# under ``with <lock>`` (reusing tier 4's lock model), and validates the
+# declaration itself — the lock and the callees must exist.  Parsed
+# lexically — keep it a literal.
+COMMIT_LOCKS: tuple = (
+    # manifest generations are read-modify-write: an ingest append and a
+    # background merge racing unserialized can resurrect replaced segments
+    (f"{_PKG}/serving/segments.py", "_COMMIT_LOCK", ("_write_manifest",)),
+)
+
 # ``--tier all`` runs two analyzers (semantic + cost) over the same
 # registry in one process; building an entry — graph synthesis, mesh
 # construction, partitioning per shrink-chain device count — is the

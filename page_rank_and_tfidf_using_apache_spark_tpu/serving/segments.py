@@ -91,7 +91,11 @@ class SegmentRef:
     nnz: int
 
     def to_json(self) -> dict:
-        return dataclasses.asdict(self)
+        # an explicit literal, not dataclasses.asdict: the tier-5
+        # schema-pair-drift check validates written vs read manifest keys
+        # lexically, so the writer side must be visible to the AST
+        return {"name": self.name, "doc_base": self.doc_base,
+                "n_docs": self.n_docs, "nnz": self.nnz}
 
     @classmethod
     def from_json(cls, d: dict) -> "SegmentRef":
@@ -178,7 +182,9 @@ def _write_manifest(directory: str, manifest: Manifest,
         with os.fdopen(fd, "w") as f:
             json.dump(payload, f, indent=2, sort_keys=True)
             f.write("\n")
-        os.replace(tmp, os.path.join(directory, name))
+        # the LATEST flip below makes this manifest pointer-visible: fsync
+        # file + parent dir before the flip can name it (tier 5)
+        ckpt.durable_replace(tmp, os.path.join(directory, name))
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -262,6 +268,82 @@ def commit_replace(directory: str, old_names: tuple[str, ...],
         shutil.rmtree(os.path.join(directory, SEGMENTS_SUBDIR, name),
                       ignore_errors=True)
     return version
+
+
+def gc_orphans(directory: str, *, min_age_s: float = 60.0) -> list[str]:
+    """Crash-recovery sweep (``tools/crash_harness.py`` runs it after
+    every SIGKILL; operators run it after any unclean shutdown): delete
+    on-disk state that no committed generation names — ``*.tmp`` files a
+    killed writer left behind, half-staged ``.vNNNN.*`` tmp directories,
+    sealed segment directories that never made it into a manifest, and
+    manifest generations NEWER than the LATEST pointer (a crash between
+    the manifest write and the pointer flip).  The committed generation's
+    segments and its deferred-GC (``replaced``) list are kept, so the
+    sweep is safe beside readers of the current generation.
+
+    The commit lock serializes the sweep against manifest commits, but
+    sealing happens OUTSIDE that lock — a segment being sealed right now
+    is indistinguishable from crash debris by name alone.  ``min_age_s``
+    is the guard: only candidates whose mtime is at least that old are
+    deleted (default 60s — far past any seal-to-commit window), so the
+    sweep is safe on a LIVE index beside in-flight seals and merges.
+    Pass ``min_age_s=0`` only when no writer can be running (the crash
+    harness's post-kill verify).  Returns the deleted paths."""
+    deleted: list[str] = []
+
+    def _old_enough(path: str) -> bool:
+        if min_age_s <= 0:
+            return True
+        try:
+            return time.time() - os.path.getmtime(path) >= min_age_s
+        except OSError:
+            return False  # vanished underneath us — nothing to delete
+
+    with _COMMIT_LOCK:
+        cur = latest_manifest(directory)
+        cur_version = 0
+        keep: set[str] = set()
+        if cur is not None:
+            cur_version = cur.version
+            keep = {s.name for s in cur.segments}
+            keep |= set(_replaced_by(directory, cur.version))
+        try:
+            names = os.listdir(directory)
+        except FileNotFoundError:
+            return []
+        for n in sorted(names):
+            p = os.path.join(directory, n)
+            if not _old_enough(p):
+                continue
+            if n.endswith(".tmp") and os.path.isfile(p):
+                os.unlink(p)
+                deleted.append(p)
+            elif (m := _MANIFEST_RE.match(n)) and int(m.group(1)) > cur_version:
+                os.unlink(p)  # written but never flipped to: unreachable
+                deleted.append(p)
+        seg_root = os.path.join(directory, SEGMENTS_SUBDIR)
+        try:
+            seg_names = os.listdir(seg_root)
+        except FileNotFoundError:
+            seg_names = []
+        for n in sorted(seg_names):
+            p = os.path.join(seg_root, n)
+            if not _old_enough(p):
+                continue
+            if n.endswith(".tmp") and os.path.isfile(p):
+                os.unlink(p)
+                deleted.append(p)
+            elif n.startswith(".") and os.path.isdir(p):
+                shutil.rmtree(p, ignore_errors=True)  # mkdtemp staging dir
+                deleted.append(p)
+            elif os.path.isdir(p) and n not in keep:
+                shutil.rmtree(p, ignore_errors=True)  # sealed, never named
+                deleted.append(p)
+    if deleted:
+        obs.emit("segment_gc_orphans", directory=directory,
+                 deleted=len(deleted), version=cur_version)
+        obs.counter("segment_orphan_gcs")
+    return deleted
 
 
 def _seg_version(name: str) -> int:

@@ -37,16 +37,59 @@ _CKPT_RE = re.compile(r"^ckpt_(\d{8})\.npz$")
 _VDIR_RE = re.compile(r"^v(\d{4})$")
 
 
+def _fsync_path(path: str) -> None:
+    """fsync one existing file or directory by path (open, fsync, close)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(directory: str) -> None:
+    """Flush a directory's entry table — the other half of a durable
+    rename.  ``os.replace`` makes the swap atomic for concurrent READERS,
+    but only an fsync of the parent directory makes the new entry itself
+    survive a power loss; without it a crash can roll the directory back
+    to a state where the pointer names a payload that never got linked."""
+    _fsync_path(directory or ".")
+
+
+def durable_replace(src: str, dst: str) -> None:
+    """The blessed commit idiom for pointer-visible writes (graftlint
+    tier 5, ``atomic-write-drift``): fsync the staged payload — a file, or
+    a staged directory plus every file in it — atomically rename it into
+    place, then fsync the destination's parent directory so the rename
+    itself is durable.  Readers never see a torn payload (the rename is
+    atomic) AND a crash after return can never lose state that a pointer
+    flip — possibly this very call — has made reachable."""
+    if os.path.isdir(src):
+        # every file AND every directory entry table, bottom-up — a
+        # nested member renamed into place un-fsynced would be exactly
+        # the lost-payload class this helper exists to close
+        for dirpath, _dirnames, filenames in os.walk(src, topdown=False):
+            for name in sorted(filenames):
+                _fsync_path(os.path.join(dirpath, name))
+            _fsync_path(dirpath)
+    else:
+        _fsync_path(src)
+    os.replace(src, dst)  # graftlint: disable=atomic-write-drift (this IS the blessed idiom's interior)
+    fsync_dir(os.path.dirname(os.path.abspath(dst)))
+
+
 def _write_pointer(directory: str, name: str, pointer: str = "LATEST") -> None:
-    """Atomically flip the directory's pointer file to ``name`` — the same
+    """Durably flip the directory's pointer file to ``name`` — the same
     tmp-file hygiene as the checkpoint payload write (a failure between
-    mkstemp and replace must not leak the tempfile)."""
+    mkstemp and replace must not leak the tempfile), with the flip itself
+    fsync'd: a pointer that names only fsync'd payloads but is not itself
+    durable can still vanish on power loss, silently rolling back a
+    commit the caller already reported."""
     ptr = os.path.join(directory, pointer)
     fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
         with os.fdopen(fd, "w") as f:
             f.write(name)
-        os.replace(tmp, ptr)
+        durable_replace(tmp, ptr)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -77,7 +120,10 @@ def save_checkpoint(
                 **{k: np.asarray(v) for k, v in arrays.items()},
                 **{_META_KEY: np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)},
             )
-        os.replace(tmp, path)  # atomic on POSIX
+        # fsync + atomic rename + parent-dir fsync: the LATEST flip below
+        # makes this payload pointer-visible, so the write must be durable
+        # BEFORE the pointer can name it (tier-5 atomic-write-drift)
+        durable_replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -190,7 +236,9 @@ def save_array_dir(
         with open(os.path.join(tmp, "META.json"), "w") as f:
             json.dump(meta, f, indent=2, sort_keys=True)
             f.write("\n")
-        os.replace(tmp, final)  # atomic on POSIX: the dir appears whole
+        # fsync every member + the staged dir + the parent: the dir must
+        # appear whole AND durable before the LATEST flip names it
+        durable_replace(tmp, final)
     finally:
         if os.path.exists(tmp):
             import shutil

@@ -342,3 +342,39 @@ print("segment hot-swap scenario: OK — "
       f"{len(finished)} requests audited across 4 hot swaps + merge, "
       "dropped=0 double_served=0, merge fault retried")
 EOF
+
+# ---------------------------------------------------------------------------
+# crash-recovery scenario (ISSUE 14): SIGKILL a committing ingest child at
+# EVERY enumerated write boundary of the seal+commit_append protocol (the
+# streaming delta-segment commit path) via tools/crash_harness.py.  After
+# each kill the reloaded segment set must serve byte-identically to the
+# pre-kill generation (a kill anywhere before the final LATEST flip) or
+# the committed one — never a torn set — and a post-recovery
+# serving.segments.gc_orphans pass must leave zero orphan tmp/unnamed
+# dirs (a second sweep and an independent re-scan both find nothing).
+echo "== chaos: SIGKILL mid-commit_append at every write boundary (crash harness) =="
+python - <<'EOF'
+import json
+import subprocess
+import sys
+
+proc = subprocess.run(
+    [sys.executable, "tools/crash_harness.py", "--scenarios", "append",
+     "--json"],
+    capture_output=True, text=True, timeout=300,
+)
+if proc.returncode != 0:
+    sys.stderr.write(proc.stderr[-3000:])
+    raise SystemExit("crash harness failed")
+rep = json.loads(proc.stdout)["append"]
+assert rep["boundaries"] >= 4, rep  # seal (2 renames) + commit (2 renames)
+assert len(rep["kills"]) == rep["boundaries"], rep
+# every pre-flip kill must serve the PRE-kill generation byte-identically
+assert rep["served_pre"] >= 1 and rep["served_pre"] + rep["served_post"] \
+    == rep["boundaries"], rep
+print("crash-recovery scenario: OK — "
+      f"{rep['boundaries']} SIGKILL point(s) through commit_append, "
+      f"{rep['served_pre']} served the pre-kill generation / "
+      f"{rep['served_post']} the committed one, 0 torn, 0 orphans "
+      "after recovery GC")
+EOF

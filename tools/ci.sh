@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Full CI pipeline: tier-1 tests, all four graftlint tiers, and the chaos
+# Full CI pipeline: tier-1 tests, all five graftlint tiers, and the chaos
 # gate.
 #
 # The semantic lint tier (tier 2: CPU-only jaxpr tracing of every
@@ -69,6 +69,57 @@ case "$lock_dot" in
        exit 1 ;;
 esac
 echo "lock-graph smoke: OK ($(printf '%s\n' "$lock_dot" | grep -c ' -> ') edge(s) emitted)"
+
+echo "== graftlint tier 5 (persistence, budget ${GRAFT_PERSIST_BUDGET_S:-10}s; incl. crash-point smoke) =="
+# Persistence & crash-consistency analysis (atomic-write drift,
+# pointer-flip ordering, generation-deferred GC, ARTIFACT_SCHEMAS
+# writer/reader drift, commit-lock drift) is pure AST — stdlib-only like
+# tiers 1/4 — under its own declared budget knob.  ONE invocation serves
+# both gates: exit code = findings gate, captured stdout = the
+# --crash-points smoke — the derived crash-surface enumeration must stay
+# emittable and must still contain the two commit_append rename
+# boundaries the crash harness SIGKILLs.
+t0=$(date +%s)
+crash_json=$(tools/lint.sh --tier 5 --crash-points --json)
+dt=$(( $(date +%s) - t0 ))
+echo "persistence tier: ${dt}s"
+if [ "$dt" -gt "${GRAFT_PERSIST_BUDGET_S:-10}" ]; then
+    echo "FAIL: persistence tier exceeded its ${GRAFT_PERSIST_BUDGET_S:-10}s budget (${dt}s)" >&2
+    exit 1
+fi
+crash_tmp=$(mktemp)
+printf '%s\n' "$crash_json" > "$crash_tmp"
+python - "$crash_tmp" <<'EOF'
+import json
+import sys
+
+with open(sys.argv[1]) as f:
+    doc = json.load(f)
+assert doc["ok"] is True, doc.get("findings")
+cps = doc["crash_points"]
+# validate the commit_append entry SPECIFICALLY (a null entry or marker
+# strings borrowed from commit_replace's chains must not pass)
+entry = next((k for k in cps if k.endswith("::commit_append")), None)
+assert entry is not None, sorted(cps)
+pts = cps[entry]
+assert pts, f"{entry} enumeration is empty/null — the harness's kill schedule is gone"
+bounds = [p for p in pts if p["boundary"]]
+assert [b["op"] for b in bounds] == ["replace", "replace"], bounds
+assert "_write_manifest()" in bounds[0]["via"], bounds[0]
+assert "_write_pointer()" in bounds[1]["via"], bounds[1]
+total = sum(1 for e in cps.values() if e for _p in e)
+print(f"crash-point smoke: OK ({len(bounds)} commit_append boundary point(s), "
+      f"{total} enumerated op(s) across {len(cps)} commit sequences)")
+EOF
+rm -f "$crash_tmp"
+
+echo "== crash-harness smoke (SIGKILL at 3 commit_append boundaries) =="
+# The dynamic half of tier 5 (ISSUE 14), bounded for CI: replay the real
+# seal+commit_append protocol with a SIGKILL at 3 of its enumerated write
+# boundaries (spread across the window) and require reload to serve a
+# consistent generation — old or new, never torn — with zero orphans
+# after the recovery GC pass.  tools/chaos.sh runs the full kill matrix.
+python tools/crash_harness.py --scenarios append --max-kills 3
 
 echo "== trace-diff gate (per-phase regression across committed rounds) =="
 # Compare the two newest committed BENCH rounds: a per-phase wall-time

@@ -7,17 +7,22 @@
 # promotion / transfer-census / sharding gates), the static cost model
 # (tier 3: FLOP/byte intensity floors, pad_frac budgets over the partition
 # plans, and the buffer-donation verifier — intensity gates are advisory
-# while xla_cost_tpu.json is not TPU-measured), and the interprocedural
+# while xla_cost_tpu.json is not TPU-measured), the interprocedural
 # concurrency & buffer-lifetime analyzer (tier 4: lock-order cycles,
 # blocking-under-lock, use-after-donate, chaos-coverage drift,
-# thread/lock registry drift — stdlib-only like tier 1).  Exit 0 = clean
-# under the ratchet; exit 1 = new findings — fix them, suppress with a
-# justified "# graftlint: disable=<rule>" comment (lexical/concurrency)
-# or a registry-level suppress entry (semantic/cost), or (outside
-# ops//parallel/) baseline them with a justification.  Pass
-# --tier 1|2|3|4 to run a single tier, --changed-only for the fast
-# pre-commit path (tools/precommit.sh), --cost-report for the tier-3
-# per-entry cost table, --lock-graph for the tier-4 lock graph as DOT.
+# thread/lock registry drift — stdlib-only like tier 1), and the
+# persistence & crash-consistency analyzer (tier 5: atomic-write drift,
+# pointer-flip ordering, generation-deferred GC, ARTIFACT_SCHEMAS
+# writer/reader drift, commit-lock drift — stdlib-only; --crash-points
+# prints the derived SIGKILL surface tools/crash_harness.py replays).
+# Exit 0 = clean under the ratchet; exit 1 = new findings — fix them,
+# suppress with a justified "# graftlint: disable=<rule>" comment
+# (lexical/concurrency/persistence) or a registry-level suppress entry
+# (semantic/cost), or (outside ops//parallel/) baseline them with a
+# justification.  Pass --tier 1|2|3|4|5 to run a single tier,
+# --changed-only for the fast pre-commit path (tools/precommit.sh),
+# --cost-report for the tier-3 per-entry cost table, --lock-graph for
+# the tier-4 lock graph as DOT.
 #
 # PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
 # can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).

@@ -5,11 +5,12 @@
 #
 # Tier 1 scans just the changed files; tiers 2/3 re-trace only the jit
 # entry points whose contracted module changed (all of them when analysis/
-# itself changed); tier 4 still models the whole surface (interprocedural
-# facts do not restrict — the model is pure AST, well under a second) but
-# reports only findings in the changed files.  tools/lint.sh remains the
-# full-repo CI gate — this script is the editor-loop companion, typically
-# <2s when nothing jit-adjacent moved.
+# itself changed); tiers 4 and 5 still model the whole surface
+# (interprocedural/cross-file facts do not restrict — both models are
+# pure AST, well under a second) but report only findings in the changed
+# files.  tools/lint.sh remains the full-repo CI gate — this script is
+# the editor-loop companion, typically <2s when nothing jit-adjacent
+# moved.
 #
 # PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
 # can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).
