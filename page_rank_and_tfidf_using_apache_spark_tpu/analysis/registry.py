@@ -121,6 +121,11 @@ _PKG = "page_rank_and_tfidf_using_apache_spark_tpu"
 # keep it a literal.
 DONATED_CALLEES: tuple = (
     ("chunk_counts_carry", (3,), ("tfidf_chunk_ingest_carry",)),
+    # the owned sharded runner donates its 4-leaf carry TUPLE at argnum 0
+    # (tail slice, replicated head, lagged-delta slots) — _ShardedExec's
+    # owned invoke binds the compiled product to this name so the
+    # use-after-donate dataflow can see the consumption
+    ("owned_runner", (0,), ("pagerank_sharded_owned",)),
     ("runner", (1,), (
         "pagerank_step",
         "pagerank_step_tol_cumsum",
@@ -440,7 +445,7 @@ def _layout_device_graph_spec(layout: str):
             tail_indptr=_i32(hl.tail_indptr.shape),
         )
         return graph.n_nodes, base._replace(hybrid=hybrid)
-    bucket_src, bucket_node = ops.build_shuffle_layout(graph)
+    bucket_src, bucket_node, _bucket_w = ops.build_shuffle_layout(graph)
     shuffle = ops.ShuffleLayout(
         bucket_src=_i32(bucket_src.shape), bucket_node=_i32(bucket_node.shape)
     )
@@ -497,6 +502,172 @@ def _build_pagerank_rowsum_pallas() -> Traceable:
         variants=[("r2048xw128", (_f32((2048, 128)),))],
         anchor=pk.rowsum_pallas,
     )
+
+
+def _build_pagerank_sharded_owned() -> Traceable:
+    """The owned-slices strategy (ISSUE 15): boundary butterfly + one
+    head psum, 4-leaf donated carry — its own builder because the operand
+    structure (lookup-index edge arrays, boundary pack indices, split
+    tail/head state vectors) differs from every replicated strategy."""
+    import jax
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
+        synthetic_powerlaw,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+        pagerank_sharded as ps,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
+        NODES_AXIS,
+        make_mesh,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
+
+    graph = synthetic_powerlaw(64, 256, seed=1)
+    cfg = PageRankConfig(iterations=4, dangling="redistribute", init="uniform")
+    runners: dict[int, object] = {}
+    variants: list[tuple[str, tuple]] = []
+    for d in _shrink_chain(min(4, len(jax.devices()))):
+        mesh = make_mesh(d, NODES_AXIS)
+        sg = ps.partition_graph(graph, d, strategy="owned")
+        sh = sg.owned
+        runners[d] = ps.make_sharded_runner(sg, cfg, mesh)
+        carry = (_f32((sh.n_pad,)), _f32((sh.h_pad,)), _f32((d,)), _f32(()))
+        args = (
+            carry,
+            _i32(sh.tail_src_idx.shape), _i32(sh.tail_dst.shape),
+            _f32(sh.tail_w.shape),
+            _i32(sh.head_src_idx.shape), _i32(sh.head_slot.shape),
+            _f32(sh.head_w.shape),
+            _i32(sh.out_idx.shape),
+            _f32((sh.n_pad,)), _f32((sh.n_pad,)),
+            _f32((sh.h_pad,)), _f32((sh.h_pad,)),
+            _f32((sh.n_pad,)), _f32((sh.h_pad,)),
+        )
+        variants.append((f"owned-d{d}", args))
+
+    def dispatch(carry, tsrc, *rest):
+        # the edge arrays are [d, e_dev]: the leading dim names which
+        # compiled program this variant exercises
+        return runners[tsrc.shape[0]](carry, tsrc, *rest)
+
+    # The donation verifier lowers donate_fn with variants[0]'s args —
+    # order the chain SMALLEST-first so that is the d=1 program: the CPU
+    # backend's multi-device SPMD lowering drops input/output aliasing
+    # entirely (0 aliased buffers at d>1 regardless of donate_argnums),
+    # so the single-device lowering is the one place the donate_argnums
+    # contract is statically checkable off-TPU.
+    variants.reverse()
+    return Traceable(
+        fn=dispatch,
+        variants=variants,
+        anchor=ps.make_sharded_runner,
+        donate_fn=runners[min(runners)],
+    )
+
+
+def _owned_pad_plan():
+    """Both padding gauges of the owned plan on the trace graph, one
+    point per shrink-chain device count: the edge-slot pad_frac (same
+    gauge as every strategy) AND the boundary-buffer pad fraction (the
+    'pad ceilings over boundary buffers' the ISSUE budgets).  d=1 has no
+    exchange, so no boundary point (its 1-slot placeholder buffer is
+    100% padding by construction and gauges nothing)."""
+
+    def plan() -> list[tuple[str, float]]:
+        import jax
+
+        from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
+            synthetic_powerlaw,
+        )
+        from page_rank_and_tfidf_using_apache_spark_tpu.parallel.pagerank_sharded import (
+            plan_partition,
+        )
+
+        graph = synthetic_powerlaw(64, 256, seed=1)
+        points: list[tuple[str, float]] = []
+        for d in _shrink_chain(min(4, len(jax.devices()))):
+            p = plan_partition(graph, d, strategy="owned")
+            points.append((f"owned-d{d}", p.pad_frac))
+            if d > 1:
+                points.append(
+                    (f"owned-d{d}-boundary", p.owned.boundary_pad_frac)
+                )
+        return points
+
+    return plan
+
+
+def _owned_pair_variants(kind: str):
+    """Shared builder half of the owned HITS/CC entries: per shrink-chain
+    device count, the (forward, reverse) owned shards and the compiled
+    runner, plus that count's abstract operand specs."""
+    import jax
+
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
+        synthetic_powerlaw,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+        workloads_sharded as ws,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
+        NODES_AXIS,
+        make_mesh,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+        ComponentsConfig,
+        HitsConfig,
+    )
+
+    graph = synthetic_powerlaw(64, 256, seed=1)
+    runners: dict[int, object] = {}
+    variants: list[tuple[str, tuple]] = []
+    for d in _shrink_chain(min(4, len(jax.devices()))):
+        mesh = make_mesh(d, NODES_AXIS)
+        sf, sr = ws.build_owned_pair(graph, d, "float32")
+        fe = (_i32(sf.tail_src_idx.shape), _i32(sf.tail_dst.shape),
+              _f32(sf.tail_w.shape), _i32(sf.out_idx.shape))
+        re_ = (_i32(sr.tail_src_idx.shape), _i32(sr.tail_dst.shape),
+               _f32(sr.tail_w.shape), _i32(sr.out_idx.shape))
+        if kind == "hits":
+            runners[d] = ws.make_hits_sharded_runner(
+                sf, sr, HitsConfig(iterations=4, tol=0.0), mesh
+            )
+            carry = (_f32((sf.n_pad,)), _f32((sf.n_pad,)))
+            args = (carry, *fe, *re_)
+        else:
+            runners[d] = ws.make_components_sharded_runner(
+                sf, sr, ComponentsConfig(iterations=8), mesh
+            )
+            # the CC runner takes (fsrc, fdst, rsrc, rdst, fout, rout)
+            args = (_i32((sf.n_pad,)), fe[0], fe[1], re_[0], re_[1],
+                    fe[3], re_[3])
+        variants.append((f"{kind}-owned-d{d}", args))
+
+    def dispatch(carry, head, *rest):
+        return runners[head.shape[0]](carry, head, *rest)
+
+    return dispatch, variants
+
+
+def _build_hits_sharded_owned() -> Traceable:
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+        workloads_sharded as ws,
+    )
+
+    dispatch, variants = _owned_pair_variants("hits")
+    return Traceable(fn=dispatch, variants=variants,
+                     anchor=ws.make_hits_sharded_runner)
+
+
+def _build_components_sharded_owned() -> Traceable:
+    from page_rank_and_tfidf_using_apache_spark_tpu.parallel import (
+        workloads_sharded as ws,
+    )
+
+    dispatch, variants = _owned_pair_variants("cc")
+    return Traceable(fn=dispatch, variants=variants,
+                     anchor=ws.make_components_sharded_runner)
 
 
 def _build_pagerank_sharded_edges() -> Traceable:
@@ -1095,6 +1266,39 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         intensity_floor=0.04,  # static model: 0.052 at d=4 (worst)
     ),
     EntryPoint(
+        name="pagerank_sharded_owned",
+        module=f"{_PKG}/parallel/pagerank_sharded.py",
+        build=_build_pagerank_sharded_owned,
+        watch=(
+            f"{_PKG}/ops/pagerank.py",
+            f"{_PKG}/ops/boundary.py",
+            f"{_PKG}/dataflow/fixpoint.py",
+            f"{_PKG}/parallel/mesh.py",
+            f"{_PKG}/parallel/collectives.py",
+            f"{_PKG}/parallel/compat.py",
+        ),
+        axes=("nodes",),
+        # THE owned collective contract (ISSUE 15 acceptance): log2(d)
+        # ppermute rounds of the boundary butterfly + exactly ONE psum —
+        # the [H_pad+2] head combine whose spare slots carry the dangling
+        # mass and the lagged delta, so neither adds a collective.  Worst
+        # traced point is d=4: 2 ppermutes + 1 psum = 3.
+        collective_budget=3,
+        # one compile per device count on the elastic shrink chain (4,2,1)
+        max_compiles=3,
+        # two gauges per chain point: edge-slot pad_frac (ceil remainders
+        # only — both edge classes split at edge granularity) and the
+        # boundary-buffer pad fraction (pow2 width over max |S_j|; worst
+        # trace-graph point 0.22 at d=2)
+        pad_plan=_owned_pad_plan(),
+        pad_frac_ceiling=0.30,
+        # the 4-leaf owned carry (tail slice, replicated head, dslot,
+        # gdelta) is donated at argnum 0 — per-chip state being O(n/d) is
+        # the strategy's reason to exist, so the carry may not double
+        donate=(0,),
+        intensity_floor=0.03,  # static model: 0.042 at d=4 (worst)
+    ),
+    EntryPoint(
         name="pagerank_sharded_src",
         module=f"{_PKG}/parallel/pagerank_sharded.py",
         build=_build_pagerank_sharded_src,
@@ -1114,6 +1318,39 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
         pad_plan=_sharded_pad_plan("src"),
         pad_frac_ceiling=0.25,
         intensity_floor=0.03,  # static model: 0.040 at d=4 (worst)
+    ),
+    EntryPoint(
+        name="hits_sharded_owned",
+        module=f"{_PKG}/parallel/workloads_sharded.py",
+        build=_build_hits_sharded_owned,
+        watch=(
+            f"{_PKG}/ops/boundary.py",
+            f"{_PKG}/dataflow/fixpoint.py",
+            f"{_PKG}/parallel/collectives.py",
+            f"{_PKG}/parallel/compat.py",
+        ),
+        axes=("nodes",),
+        # two boundary butterflies (2·log2(d) ppermutes) + two pmax norms
+        # + the convergence psum: 7 at the traced d=4 worst
+        collective_budget=7,
+        max_compiles=3,
+        intensity_floor=0.03,
+    ),
+    EntryPoint(
+        name="components_sharded_owned",
+        module=f"{_PKG}/parallel/workloads_sharded.py",
+        build=_build_components_sharded_owned,
+        watch=(
+            f"{_PKG}/ops/boundary.py",
+            f"{_PKG}/dataflow/fixpoint.py",
+            f"{_PKG}/parallel/collectives.py",
+            f"{_PKG}/parallel/compat.py",
+        ),
+        axes=("nodes",),
+        # two boundary butterflies + the changed-count psum: 5 at d=4
+        collective_budget=5,
+        max_compiles=3,
+        intensity_floor=0.01,
     ),
     EntryPoint(
         name="tfidf_batch_pipeline",

@@ -68,7 +68,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shard over this many devices (0 = single device)")
     p.add_argument("--shard-strategy",
                    choices=["auto", "edges", "nodes", "nodes_balanced",
-                            "src", "src_ring", "hybrid"],
+                            "src", "src_ring", "hybrid", "owned"],
                    default="auto",
                    help="graph partition under --mesh: auto (by memory "
                         "footprint + degree shape) / balanced edge slices / "

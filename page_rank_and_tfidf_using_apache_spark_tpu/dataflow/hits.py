@@ -40,18 +40,25 @@ from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsReco
 
 def hits_step(ha, dg: ops.DeviceGraph, n: int):
     """One networkx-parity HITS iteration over the ``[2, n]`` carry
-    (row 0 = hubs, row 1 = authorities)."""
+    (row 0 = hubs, row 1 = authorities).  Edge weights (when the graph
+    carries them — networkx weighted-HITS semantics) scale each edge's
+    contribution in BOTH directions; the same dst-sorted weight array
+    serves both, since each combine walks the same edge set."""
     import jax.numpy as jnp
 
     hub = ha[0]
+    per_fwd = combine.broadcast_join(hub, dg.src)
+    if dg.edge_weight is not None:
+        per_fwd = per_fwd * dg.edge_weight
     auth = combine.segment_combine(
-        combine.broadcast_join(hub, dg.src), dg.dst, n,
-        op="add", indices_are_sorted=True,
+        per_fwd, dg.dst, n, op="add", indices_are_sorted=True,
     )
     auth = auth / jnp.maximum(jnp.max(auth), 1e-30)
+    per_rev = combine.broadcast_join(auth, dg.dst)
+    if dg.edge_weight is not None:
+        per_rev = per_rev * dg.edge_weight
     new_hub = combine.segment_combine(
-        combine.broadcast_join(auth, dg.dst), dg.src, n,
-        op="add", indices_are_sorted=False,
+        per_rev, dg.src, n, op="add", indices_are_sorted=False,
     )
     new_hub = new_hub / jnp.maximum(jnp.max(new_hub), 1e-30)
     return jnp.stack([new_hub, auth])

@@ -87,3 +87,82 @@ class PartitionedArray:
                 checkpoint_dir=checkpoint_dir,
             )
         return padded[self.node_map]
+
+
+@dataclasses.dataclass(frozen=True)
+class OwnedArray:
+    """The owned-slice partitioned-collection layout (ISSUE 15): ONE
+    logical [n] array split into a device-SHARDED padded tail (each shard
+    holds only its owned block — nothing is replicated O(n)) plus a small
+    REPLICATED hub-head mini-vector, mirroring
+    ``ops.boundary.OwnedShard``'s node split.
+
+    Same contract as :class:`PartitionedArray` — callers program against
+    the logical view; host→device pads/places both components, and the
+    device→host direction is one *guarded* batched pull (retry/deadline/
+    ladder via the resilience executor) — so sharded PageRank, HITS and
+    connected components on owned slices all inherit the host-sync
+    discipline from this one class."""
+
+    n: int
+    n_pad: int  # d * block (tail layout width)
+    h: int  # real head size
+    h_pad: int
+    tail_map: np.ndarray  # int64 [n]: global id -> padded tail slot; -1 head
+    head_ids: np.ndarray  # int64 [H] ascending global ids
+    tail: Any = None  # device array [n_pad], sharded along the mesh axis
+    head: Any = None  # device array [h_pad], replicated
+    tail_sharding: Any = None
+    head_sharding: Any = None
+
+    @classmethod
+    def from_shard(cls, shard, *, tail_sharding: Any = None,
+                   head_sharding: Any = None) -> "OwnedArray":
+        """Layout view over a materialized ``ops.boundary.OwnedShard``."""
+        return cls(
+            n=shard.n, n_pad=shard.n_pad, h=shard.h, h_pad=shard.h_pad,
+            tail_map=shard.tail_map, head_ids=shard.head_ids,
+            tail_sharding=tail_sharding, head_sharding=head_sharding,
+        )
+
+    def put(self, global_np: np.ndarray, dtype=None) -> "OwnedArray":
+        """Pad + split + device_put a logical [n] host array into the
+        (sharded tail, replicated head) pair.  The split itself is
+        ``ops.boundary.split_global`` — ONE implementation of the
+        tail_map/head reassembly serves host planning and this layer."""
+        import jax
+
+        from page_rank_and_tfidf_using_apache_spark_tpu.ops import (
+            boundary as ob,
+        )
+
+        dtype = dtype or global_np.dtype
+        tail_np, head_np = ob.split_global(self, global_np, dtype)
+        tail = (jax.device_put(tail_np, self.tail_sharding)
+                if self.tail_sharding is not None
+                else jax.device_put(tail_np))
+        head = (jax.device_put(head_np, self.head_sharding)
+                if self.head_sharding is not None
+                else jax.device_put(head_np))
+        return dataclasses.replace(self, tail=tail, head=head)
+
+    def with_value(self, tail: Any, head: Any) -> "OwnedArray":
+        """The same layout around a fixpoint's output carry components."""
+        return dataclasses.replace(self, tail=tail, head=head)
+
+    def pull(self, *, site: str = "partitioned_pull", metrics=None,
+             checkpoint_dir: str | None = None) -> np.ndarray:
+        """Guarded boundary-aware pull: ONE batched transfer for both
+        components, then the tail_map/head reassembly on host."""
+        from page_rank_and_tfidf_using_apache_spark_tpu.ops import (
+            boundary as ob,
+        )
+
+        if self.tail is None or self.head is None:
+            raise ValueError("OwnedArray holds no device value")
+        with obs.span("dataflow.pull", site=site, n=self.n, owned=True):
+            tail_np, head_np = rx.device_get(
+                (self.tail, self.head), site=site, metrics=metrics,
+                checkpoint_dir=checkpoint_dir,
+            )
+        return ob.merge_global(self, tail_np, head_np)

@@ -5,6 +5,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
     parse_snap_text,
     save_ranks,
     synthetic_powerlaw,
+    synthetic_zipf,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
     TokenizedCorpus,
@@ -22,6 +23,7 @@ __all__ = [
     "parse_snap_text",
     "save_ranks",
     "synthetic_powerlaw",
+    "synthetic_zipf",
     "TokenizedCorpus",
     "iter_corpus_chunks",
     "load_corpus_dir",

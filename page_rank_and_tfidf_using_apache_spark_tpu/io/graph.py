@@ -39,10 +39,43 @@ class Graph:
     dst: np.ndarray  # int32 [n_edges], non-decreasing
     out_degree: np.ndarray  # int32 [n_nodes]
     node_ids: np.ndarray  # original ids, [n_nodes]
+    # Optional per-edge weights aligned with src/dst (same (dst, src)
+    # order).  None = unweighted.  Weights are strictly positive (enforced
+    # by from_edges): a node's dangling status then stays "no out-edges"
+    # under both conventions, and the weighted out-STRENGTH normalizer
+    # (networkx ``pagerank(weight=)`` semantics) is always finite.
+    weight: np.ndarray | None = None
 
     @property
     def n_edges(self) -> int:
         return int(self.src.shape[0])
+
+    def out_strength(self) -> np.ndarray:
+        """float64 [n_nodes] sum of outgoing edge weights (== out_degree
+        for an unweighted graph); the normalizer of the weighted SpMV.
+        Cached like csr_indptr."""
+        cached = getattr(self, "_out_strength", None)
+        if cached is None:
+            if self.weight is None:
+                cached = self.out_degree.astype(np.float64)  # graftlint: disable=dtype-drift (host-side normalizer staging; cast to the run dtype at put_graph)
+            else:
+                cached = np.bincount(
+                    self.src, weights=self.weight, minlength=self.n_nodes
+                )
+            object.__setattr__(self, "_out_strength", cached)
+        return cached
+
+    def inv_out_strength(self, dtype) -> np.ndarray:
+        """``1 / out_strength`` (0 at dangling nodes), divided in float64
+        and cast to ``dtype`` AFTER — THE one implementation every graph
+        consumer shares (put_graph, partition_graph, build_owned_shard):
+        the 1e-9 f64 chip-count-invariance pins depend on all of them
+        normalizing bit-identically."""
+        s = self.out_strength()
+        with np.errstate(divide="ignore"):
+            return np.where(
+                s > 0, 1.0 / np.where(s > 0, s, 1.0), 0.0
+            ).astype(dtype)
 
     @property
     def dangling_mask(self) -> np.ndarray:
@@ -66,6 +99,7 @@ def from_edges(
     src: np.ndarray,
     dst: np.ndarray,
     *,
+    weight: np.ndarray | None = None,
     dedup: bool = True,
     drop_self_loops: bool = False,
     compact_ids: bool = True,
@@ -73,15 +107,28 @@ def from_edges(
     """Build a :class:`Graph` from raw (src, dst) id arrays.
 
     ``dedup=True`` reproduces the reference's ``distinct()``; self-loops are
-    kept by default (``distinct()`` does not remove them).
+    kept by default (``distinct()`` does not remove them).  ``weight`` (all
+    entries > 0) rides along per edge; duplicate (src, dst) pairs SUM their
+    weights under dedup (the parallel-edge collapse networkx applies when a
+    multigraph is read as a weighted digraph).
     """
     src = np.asarray(src).ravel()
     dst = np.asarray(dst).ravel()
     if src.shape != dst.shape:
         raise ValueError(f"src/dst shape mismatch: {src.shape} vs {dst.shape}")
+    if weight is not None:
+        weight = np.asarray(weight, np.float64).ravel()  # graftlint: disable=dtype-drift (host-side edge weights; cast to the run dtype at put_graph/partition_graph)
+        if weight.shape != src.shape:
+            raise ValueError(
+                f"weight shape {weight.shape} != edge shape {src.shape}"
+            )
+        if weight.size and not (weight > 0).all():
+            raise ValueError("edge weights must be strictly positive")
     if drop_self_loops:
         keep = src != dst
         src, dst = src[keep], dst[keep]
+        if weight is not None:
+            weight = weight[keep]
 
     if compact_ids:
         node_ids, inverse = np.unique(np.concatenate([src, dst]), return_inverse=True)
@@ -108,17 +155,24 @@ def from_edges(
 
     sorted_pair = (
         native.sort_dedup_edges(src, dst, dedup=dedup)
-        if src.size and n <= (1 << 31) else None
+        if src.size and n <= (1 << 31) and weight is None else None
     )
     if sorted_pair is not None:
         src, dst = sorted_pair
     else:
         order = np.lexsort((src, dst))
         src, dst = src[order], dst[order]
+        if weight is not None:
+            weight = weight[order]
         if dedup and src.size:
             keep = np.empty(src.shape, dtype=bool)
             keep[0] = True
             keep[1:] = (src[1:] != src[:-1]) | (dst[1:] != dst[:-1])
+            if weight is not None:
+                # duplicate (src, dst) pairs collapse to one edge carrying
+                # the SUM of their weights (groups are contiguous after the
+                # lexsort, so one reduceat covers them all)
+                weight = np.add.reduceat(weight, np.flatnonzero(keep))
             src, dst = src[keep], dst[keep]
 
     out_degree = np.bincount(src, minlength=n).astype(np.int32)
@@ -128,6 +182,7 @@ def from_edges(
         dst=dst.astype(np.int32),
         out_degree=out_degree,
         node_ids=node_ids,
+        weight=weight,
     )
 
 
@@ -199,3 +254,86 @@ def synthetic_powerlaw(
     perm = rng.permutation(n_nodes)
     dst = perm[z]
     return from_edges(src, dst)
+
+
+def synthetic_zipf(
+    n_nodes: int,
+    n_edges: int,
+    *,
+    seed: int = 0,
+    exponent: float = 1.5,
+    src_exponent: float | None = None,
+) -> Graph:
+    """Seeded Zipf graph hitting its TARGET counts exactly: exactly
+    ``n_nodes`` nodes and exactly ``n_edges`` unique edges (ISSUE 15
+    satellite; :func:`synthetic_powerlaw` only aims near them — dedup
+    shrinks its edge count by a seed-dependent few percent, which makes
+    cross-scale comparisons like the owned-strategy comm-bytes sweep
+    noisy).  Destinations are Zipf(``exponent``) over a random
+    permutation, so hub IN-degree follows the power law the sharded
+    planners are stressed by; sources are uniform by default, or
+    Zipf(``src_exponent``) over an independent permutation — the
+    both-axes power law real web graphs have (SNAP web-Google's
+    out-degree is as heavy-tailed as its in-degree), and the shape class
+    under which the owned strategy's boundary is hub-dominated: distinct
+    sources drawn from a Zipf(a) grow ~n^(1/a), so cut-crossing entries —
+    and with them per-step comm bytes — are SUBLINEAR in node count (the
+    MULTICHIP scale sweep measures exactly this exponent).
+
+    Top-up rounds oversample until the deduped pool reaches the target,
+    then a seeded uniform subsample trims to it — trimming uniformly
+    preserves the degree distribution's shape.
+    """
+    if n_nodes < 2:
+        raise ValueError(f"synthetic_zipf needs n_nodes >= 2, got {n_nodes}")
+    if n_edges < 2:
+        raise ValueError(f"synthetic_zipf needs n_edges >= 2, got {n_edges}")
+    if n_edges > n_nodes * (n_nodes - 1):
+        raise ValueError(
+            f"target {n_edges} edges exceeds the simple-digraph capacity "
+            f"of {n_nodes} nodes"
+        )
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n_nodes)
+    perm_s = rng.permutation(n_nodes) if src_exponent is not None else None
+    # Hub SOURCES (the top source ranks) link uniformly; only tail
+    # sources link preferentially (Zipf destinations).  A directory hub
+    # links broadly, a niche page links into the popular head — and
+    # without the split, the (hub src × hub dst) pair mass makes i.i.d.
+    # unique-edge sampling collide so hard the top-up loop crawls at 10x
+    # scale (its distinct-pair capacity saturates).
+    src_hub_ranks = 1024
+    # Pin ids 0 and n_nodes-1 so the node COUNT is exact without id
+    # compaction renumbering anything (dedup may drop the duplicates).
+    keys = {np.int64(0) * n_nodes + (n_nodes - 1),
+            np.int64(n_nodes - 1) * n_nodes + 0}
+    pool = np.fromiter(keys, np.int64)
+    accept = 1.0  # unique yield of the previous round, sizes the next
+    while pool.size < n_edges:
+        want = max(n_edges - pool.size, 1024)
+        batch = int(min(want / max(accept, 0.05) * 1.25, 4 * n_edges)) + 64
+        z = np.minimum(rng.zipf(exponent, size=batch) - 1, n_nodes - 1)
+        dst = perm[z]
+        if perm_s is None:
+            src = rng.integers(0, n_nodes, size=batch, dtype=np.int64)
+        else:
+            zs = np.minimum(rng.zipf(src_exponent, size=batch) - 1,
+                            n_nodes - 1)
+            src = perm_s[zs]
+            hub = zs < src_hub_ranks
+            dst[hub] = rng.integers(0, n_nodes, size=int(hub.sum()),
+                                    dtype=np.int64)
+        before = pool.size
+        pool = np.unique(np.concatenate([pool, src * n_nodes + dst]))
+        accept = max((pool.size - before) / batch, 0.01)
+    if pool.size > n_edges:
+        # keep the two pinned endpoint edges; trim the rest uniformly
+        pinned = np.isin(pool, np.fromiter(keys, np.int64))
+        rest = np.flatnonzero(~pinned)
+        take = rng.choice(rest, n_edges - int(pinned.sum()), replace=False)
+        pool = np.concatenate([pool[pinned], pool[take]])
+    src = pool // n_nodes
+    dst = pool % n_nodes
+    g = from_edges(src, dst, dedup=False, compact_ids=False)
+    assert g.n_nodes == n_nodes and g.n_edges == n_edges
+    return g

@@ -65,6 +65,8 @@ class HybridLayout(NamedTuple):
     tail_src: jax.Array  # int32 [Et]
     tail_dst: jax.Array  # int32 [Et], non-decreasing
     tail_indptr: jax.Array  # int32 [N+1] CSR pointers over the tail edges
+    head_w: jax.Array | None = None  # f [R, W] edge weights (0 at sentinels)
+    tail_w: jax.Array | None = None  # f [Et] edge weights
 
 
 class ShuffleLayout(NamedTuple):
@@ -78,6 +80,7 @@ class ShuffleLayout(NamedTuple):
 
     bucket_src: jax.Array  # int32 [NB, B] per-bucket edge sources
     bucket_node: jax.Array  # int32 [NB] bucket -> dst node, non-decreasing
+    bucket_w: jax.Array | None = None  # f [NB, B] edge weights (0 at pads)
 
 
 class DeviceGraph(NamedTuple):
@@ -86,12 +89,17 @@ class DeviceGraph(NamedTuple):
 
     src: jax.Array  # int32 [E], edge sources, dst-sorted order
     dst: jax.Array  # int32 [E], non-decreasing
-    inv_outdeg: jax.Array  # f[N], 1/out_degree (0 at dangling nodes)
+    inv_outdeg: jax.Array  # f[N], 1/out_degree — 1/out_STRENGTH on a
+    # weighted graph — (0 at dangling nodes)
     dangling: jax.Array  # f[N], 1.0 where out_degree == 0
     has_outlinks: jax.Array  # f[N], 1.0 where out_degree > 0
     indptr: jax.Array | None = None  # int32 [N+1], CSR row pointers into dst
     hybrid: HybridLayout | None = None  # spmv_impl='hybrid' static layout
     shuffle: ShuffleLayout | None = None  # spmv_impl='sort_shuffle' layout
+    # Per-edge weights in dst-sorted order (weighted PageRank, ISSUE 15):
+    # the SpMV contribution becomes ``w(u,v) * rank[u] / strength[u]`` —
+    # networkx ``pagerank(weight=)`` semantics.  None = unweighted.
+    edge_weight: jax.Array | None = None
 
 
 def _pow2_floor(x: int) -> int:
@@ -143,6 +151,8 @@ class HybridHostLayout(NamedTuple):
     tail_indptr: np.ndarray
     head_edges: int
     pad_slots: int  # sentinel slots in the dense rows
+    head_w: np.ndarray | None = None  # [R, W] weights (0 at sentinels)
+    tail_w: np.ndarray | None = None  # [Et] weights
 
 
 def build_hybrid_layout(
@@ -167,6 +177,8 @@ def build_hybrid_layout(
     rows_per = -(-deg // w)
     r = int(rows_per.sum())
     head_src = np.full((r, w), n, np.int32)
+    weighted = graph.weight is not None
+    head_w = np.zeros((r, w), np.float64) if weighted else None  # graftlint: disable=dtype-drift (host staging; cast to the run dtype at put_graph)
     head_row_node = np.repeat(
         np.arange(head_ids.size, dtype=np.int64), rows_per
     ).astype(np.int32)
@@ -177,9 +189,10 @@ def build_hybrid_layout(
             run_start[:-1], deg
         )
         e_idx = np.repeat(ip[head_ids], deg) + offs
-        head_src[np.repeat(row_start[:-1], deg) + offs // w, offs % w] = (
-            graph.src[e_idx]
-        )
+        rows = np.repeat(row_start[:-1], deg) + offs // w
+        head_src[rows, offs % w] = graph.src[e_idx]
+        if weighted:
+            head_w[rows, offs % w] = graph.weight[e_idx]
 
     keep = ~in_head[graph.dst]
     tail_src = graph.src[keep].astype(np.int32)
@@ -195,22 +208,30 @@ def build_hybrid_layout(
         tail_indptr=tail_indptr,
         head_edges=head_edges,
         pad_slots=r * w - head_edges,
+        head_w=head_w,
+        tail_w=graph.weight[keep] if weighted else None,
     )
 
 
 def build_shuffle_layout(graph: Graph, *, bucket_width: int = 8) -> tuple[
-    np.ndarray, np.ndarray
+    np.ndarray, np.ndarray, np.ndarray | None
 ]:
     """One-time host pass for the sort-based static shuffle: pad every
     destination's (already dst-sorted) edge run to whole buckets of width
-    ``bucket_width``.  Returns ``(bucket_src [NB, B], bucket_node [NB])``
-    — fully vectorized, no per-node python loop."""
+    ``bucket_width``.  Returns ``(bucket_src [NB, B], bucket_node [NB],
+    bucket_w [NB, B] | None)`` — fully vectorized, no per-node python
+    loop; ``bucket_w`` carries per-edge weights (0 at pad slots) for a
+    weighted graph."""
     n, e, b = graph.n_nodes, graph.n_edges, bucket_width
     ip = graph.csr_indptr()
     indeg = np.diff(ip)
     buckets_per = -(-indeg // b)
     nb = int(buckets_per.sum())
     bucket_src = np.full((nb, b), n, np.int32)
+    bucket_w = (
+        np.zeros((nb, b), np.float64)  # graftlint: disable=dtype-drift (host staging; cast to the run dtype at put_graph)
+        if graph.weight is not None else None
+    )
     bucket_node = np.repeat(
         np.arange(n, dtype=np.int64), buckets_per
     ).astype(np.int32)
@@ -220,7 +241,9 @@ def build_shuffle_layout(graph: Graph, *, bucket_width: int = 8) -> tuple[
         bucket_start = np.concatenate([[0], np.cumsum(buckets_per)])
         row = np.repeat(bucket_start[:-1], indeg) + offs // b
         bucket_src[row, offs % b] = graph.src
-    return bucket_src, bucket_node
+        if bucket_w is not None:
+            bucket_w[row, offs % b] = graph.weight
+    return bucket_src, bucket_node, bucket_w
 
 
 def put_graph(
@@ -245,9 +268,10 @@ def put_graph(
     never read them, and at bench scale they are ~3E dead int32 on HBM
     plus transfer time — only valid when the caller commits to a
     layout-backed impl (models.pagerank.put_graph_for does)."""
-    outdeg = graph.out_degree.astype(dtype)
-    with np.errstate(divide="ignore"):
-        inv = np.where(outdeg > 0, 1.0 / np.maximum(outdeg, 1), 0.0).astype(dtype)
+    # Weighted graphs normalize by out-STRENGTH (Σ outgoing weights —
+    # networkx stochastic_graph semantics); unweighted by out-degree.
+    # Dangling is out_degree == 0 under both (weights are positive).
+    inv = graph.inv_out_strength(dtype)
     if not keep_edge_arrays and layout is None:
         raise ValueError("keep_edge_arrays=False requires a static layout")
     src_h = graph.src if keep_edge_arrays else np.zeros(0, np.int32)
@@ -255,6 +279,11 @@ def put_graph(
     indptr = (
         graph.csr_indptr().astype(np.int32)
         if keep_edge_arrays else np.zeros(0, np.int32)
+    )
+    weighted = graph.weight is not None
+    edge_weight = (
+        jnp.asarray(graph.weight.astype(dtype))
+        if weighted and keep_edge_arrays else None
     )
     hybrid = None
     shuffle = None
@@ -269,14 +298,20 @@ def put_graph(
             tail_src=jnp.asarray(hl.tail_src),
             tail_dst=jnp.asarray(hl.tail_dst),
             tail_indptr=jnp.asarray(hl.tail_indptr),
+            head_w=(jnp.asarray(hl.head_w.astype(dtype))
+                    if hl.head_w is not None else None),
+            tail_w=(jnp.asarray(hl.tail_w.astype(dtype))
+                    if hl.tail_w is not None else None),
         )
     elif layout == "sort_shuffle":
-        bucket_src, bucket_node = build_shuffle_layout(
+        bucket_src, bucket_node, bucket_w = build_shuffle_layout(
             graph, bucket_width=bucket_width
         )
         shuffle = ShuffleLayout(
             bucket_src=jnp.asarray(bucket_src),
             bucket_node=jnp.asarray(bucket_node),
+            bucket_w=(jnp.asarray(bucket_w.astype(dtype))
+                      if bucket_w is not None else None),
         )
     elif layout is not None:
         raise ValueError(f"unknown graph layout {layout!r}")
@@ -289,6 +324,7 @@ def put_graph(
         indptr=jnp.asarray(indptr),
         hybrid=hybrid,
         shuffle=shuffle,
+        edge_weight=edge_weight,
     )
 
 
@@ -321,12 +357,23 @@ def init_ranks(n: int, cfg: PageRankConfig) -> np.ndarray:
     return np.full(n, 1.0 / n, dtype=cfg.dtype)
 
 
-def spmv_segment(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
-    """contribs[v] = Σ_{(u,v)∈E} weighted_ranks[u] via sorted segment_sum —
-    the `reduceByKey(add)` of BASELINE.json:5 as one segmented reduction."""
+def _edge_values(dg: DeviceGraph, weighted_ranks: jax.Array) -> jax.Array:
+    """Per-edge contribution ``weighted_ranks[src] (* w(src, dst))`` — the
+    one place the optional edge-weight multiply lives for the raw-edge
+    impls (segment/cumsum/cumsum_mxu/pallas share it)."""
     per_edge = weighted_ranks[dg.src]
+    if dg.edge_weight is not None:
+        per_edge = per_edge * dg.edge_weight
+    return per_edge
+
+
+def spmv_segment(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
+    """contribs[v] = Σ_{(u,v)∈E} w(u,v)·weighted_ranks[u] via sorted
+    segment_sum — the `reduceByKey(add)` of BASELINE.json:5 as one
+    segmented reduction (w ≡ 1 unweighted)."""
     return jax.ops.segment_sum(
-        per_edge, dg.dst, num_segments=n, indices_are_sorted=True
+        _edge_values(dg, weighted_ranks), dg.dst,
+        num_segments=n, indices_are_sorted=True,
     )
 
 
@@ -335,9 +382,12 @@ def spmv_bcoo(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
     BASELINE.json:5 prescription) — kept as a benchmarked alternative."""
     from jax.experimental import sparse
 
-    ones = jnp.ones_like(weighted_ranks, shape=dg.src.shape)
+    data = (
+        dg.edge_weight if dg.edge_weight is not None
+        else jnp.ones_like(weighted_ranks, shape=dg.src.shape)
+    )
     mat = sparse.BCOO(
-        (ones, jnp.stack([dg.dst, dg.src], axis=1)),
+        (data, jnp.stack([dg.dst, dg.src], axis=1)),
         shape=(n, n),
         indices_sorted=True,
         unique_indices=True,
@@ -392,7 +442,7 @@ def spmv_cumsum(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array
     """
     if dg.indptr is None:
         raise ValueError("spmv_impl='cumsum' needs DeviceGraph.indptr (use put_graph)")
-    return cumsum_diff_spmv(weighted_ranks[dg.src], dg.indptr)
+    return cumsum_diff_spmv(_edge_values(dg, weighted_ranks), dg.indptr)
 
 
 def spmv_cumsum_mxu(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array:
@@ -400,7 +450,7 @@ def spmv_cumsum_mxu(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.A
     as the scan primitive — same accuracy class as spmv_cumsum."""
     if dg.indptr is None:
         raise ValueError("spmv_impl='cumsum_mxu' needs DeviceGraph.indptr (use put_graph)")
-    return cumsum_diff_spmv(weighted_ranks[dg.src], dg.indptr,
+    return cumsum_diff_spmv(_edge_values(dg, weighted_ranks), dg.indptr,
                             cumsum_fn=cumsum_blocked)
 
 
@@ -436,7 +486,10 @@ def spmv_hybrid(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array
     if hl is None:
         raise ValueError("spmv_impl='hybrid' needs put_graph(layout='hybrid')")
     if hl.tail_src.shape[0]:
-        contribs = cumsum_diff_spmv(weighted_ranks[hl.tail_src], hl.tail_indptr)
+        per_tail = weighted_ranks[hl.tail_src]
+        if hl.tail_w is not None:
+            per_tail = per_tail * hl.tail_w
+        contribs = cumsum_diff_spmv(per_tail, hl.tail_indptr)
     else:
         contribs = jnp.zeros(n, weighted_ranks.dtype)
     h = hl.head_ids.shape[0]
@@ -444,7 +497,10 @@ def spmv_hybrid(dg: DeviceGraph, weighted_ranks: jax.Array, n: int) -> jax.Array
         w_ext = jnp.concatenate(
             [weighted_ranks, jnp.zeros(1, weighted_ranks.dtype)]
         )
-        row_sums = hybrid_rowsum(w_ext[hl.head_src])
+        rows = w_ext[hl.head_src]
+        if hl.head_w is not None:
+            rows = rows * hl.head_w  # sentinel slots carry weight 0
+        row_sums = hybrid_rowsum(rows)
         head = jax.ops.segment_sum(
             row_sums, hl.head_row_node, num_segments=h, indices_are_sorted=True
         )
@@ -470,7 +526,10 @@ def spmv_sort_shuffle(
     w_ext = jnp.concatenate(
         [weighted_ranks, jnp.zeros(1, weighted_ranks.dtype)]
     )
-    bucket_sums = w_ext[sl.bucket_src].sum(axis=1)
+    vals = w_ext[sl.bucket_src]
+    if sl.bucket_w is not None:
+        vals = vals * sl.bucket_w  # pad slots carry weight 0
+    bucket_sums = vals.sum(axis=1)
     return jax.ops.segment_sum(
         bucket_sums, sl.bucket_node, num_segments=n, indices_are_sorted=True
     )
@@ -502,7 +561,8 @@ def spmv(dg: DeviceGraph, weighted: jax.Array, n: int, impl: str) -> jax.Array:
         # Mosaic only compiles on real TPUs; everywhere else (CPU tests,
         # simulated meshes) run the same kernel under the interpreter.
         interpret = jax.default_backend() not in ("tpu", "axon")
-        return pk.spmv_pallas(dg.src, dg.indptr, weighted, n=n, interpret=interpret)
+        return pk.spmv_pallas(dg.src, dg.indptr, weighted, n=n,
+                              edge_weight=dg.edge_weight, interpret=interpret)
     raise ValueError(f"unknown spmv impl {impl!r}")
 
 

@@ -147,6 +147,7 @@ def spmv_pallas(
     w: jax.Array,
     *,
     n: int,
+    edge_weight: jax.Array | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """``contribs[v] = Σ_{e: dst-sorted, dst[e]=v} w[src[e]]`` with the
@@ -166,6 +167,9 @@ def spmv_pallas(
     e = src.shape[0]
     if e == 0:
         return jnp.zeros(n, w.dtype)
+    per_edge = w[src]
+    if edge_weight is not None:  # weighted PageRank: w(u,v)·rank[u]/s[u]
+        per_edge = per_edge * edge_weight
     return cumsum_diff_spmv(
-        w[src], indptr, functools.partial(cumsum_pallas, interpret=interpret)
+        per_edge, indptr, functools.partial(cumsum_pallas, interpret=interpret)
     )

@@ -15,6 +15,11 @@ from page_rank_and_tfidf_using_apache_spark_tpu.parallel.pagerank_sharded import
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.tfidf_sharded import (
     run_tfidf_sharded,
 )
+from page_rank_and_tfidf_using_apache_spark_tpu.parallel.workloads_sharded import (
+    run_components_sharded,
+    run_hits_sharded,
+    run_ppr_sharded,
+)
 
 __all__ = [
     "DATA_AXIS",
@@ -28,4 +33,7 @@ __all__ = [
     "partition_graph",
     "run_pagerank_sharded",
     "run_tfidf_sharded",
+    "run_components_sharded",
+    "run_hits_sharded",
+    "run_ppr_sharded",
 ]

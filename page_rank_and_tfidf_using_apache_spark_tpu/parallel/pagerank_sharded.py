@@ -52,10 +52,24 @@ both exist):
   pads ``nodes``/``nodes_balanced`` to 0.6 cannot occur: the plan-level
   ``pad_frac`` stays at the ceil-remainder level of ``edges`` plus the
   head rows' sentinel slots.
+- ``owned``: the break-the-replicated-state-wall layout (ISSUE 15;
+  *Sparse Allreduce*'s hub-peeled sparse exchange over DrJAX-style native
+  collectives — see ``ops/boundary.py`` for the full anatomy).  Each
+  shard owns ONLY its tail block's rank slice; a small combined-degree
+  hub head is the one replicated mini-state (its contributions combine
+  in ONE [H_pad+2] ``psum`` that also carries the dangling mass and the
+  one-step-lagged global delta — so per step the ONLY collectives are
+  the log₂(d) ``ppermute`` rounds of the boundary butterfly plus that
+  single psum); every other cross-shard read moves through fixed-width
+  padded boundary buffers holding just the cut-crossing entries.  State
+  per chip is O(n/d + H), comm per step is O(boundary + H) — both
+  sublinear in n on power-law graphs, which is what lets 10-100x
+  web-Google node counts run at all.
 - ``auto``: picks by memory footprint and degree shape — ``hybrid`` when
   the replicated node state fits per-chip HBM and the graph has a
   dense-worthy power-law head, ``edges`` when it fits but has no head,
-  ``nodes_balanced`` beyond (see :func:`auto_select_strategy`).
+  ``owned`` beyond (replicated-state-doesn't-fit is the trigger; see
+  :func:`auto_select_strategy`).
 
 Both run the whole iteration loop inside one ``jit`` + ``shard_map``
 program: collectives are compiled into the loop body, so there are zero
@@ -83,9 +97,13 @@ from page_rank_and_tfidf_using_apache_spark_tpu.dataflow import fixpoint as data
 from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.partition import (
     PartitionedArray,
 )
+from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.partition import (
+    OwnedArray,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
 from page_rank_and_tfidf_using_apache_spark_tpu.models import driver
 from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import PageRankResult
+from page_rank_and_tfidf_using_apache_spark_tpu.ops import boundary as ob
 from page_rank_and_tfidf_using_apache_spark_tpu.ops import pagerank as ops
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel import collectives as coll
 from page_rank_and_tfidf_using_apache_spark_tpu.parallel.mesh import (
@@ -106,6 +124,21 @@ from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsReco
 
 
 DEFAULT_HBM_BYTES = 8 << 30  # conservative per-chip working budget (v5e: 16G)
+
+
+def replicated_state_bytes(
+    n_nodes: int, n_edges: int, n_devices: int, dtype: str = "float32"
+) -> int:
+    """The per-chip footprint of a REPLICATED-rank strategy: ~6 node
+    vectors live at once (ranks, new ranks, contribs, inv_outdeg,
+    dangling, e) plus this chip's edge slice (src/dst int32 + the
+    coefficient mask).  One model shared by :func:`auto_select_strategy`
+    and the replicated-wall assertions in bench.py/__graft_entry__.py —
+    the selector and the acceptance harnesses must not drift apart."""
+    item = np.dtype(dtype).itemsize
+    node_state = 6 * n_nodes * item
+    edge_state = int(n_edges / max(n_devices, 1) * (8 + item))
+    return int(node_state + edge_state)
 
 
 def auto_select_strategy(
@@ -130,12 +163,9 @@ def auto_select_strategy(
 
     if hbm_bytes is None:
         hbm_bytes = int(os.environ.get("PR_TFIDF_HBM_BYTES", DEFAULT_HBM_BYTES))
-    item = np.dtype(dtype).itemsize
-    # replicated layout, per chip: ~6 node vectors live at once (ranks, new
-    # ranks, contribs, inv_outdeg, dangling, e) + the edge slice
-    # (src/dst int32 + valid).
-    node_state = 6 * graph.n_nodes * item
-    edge_state = (graph.n_edges / max(n_devices, 1)) * (8 + item)
+    replicated = replicated_state_bytes(
+        graph.n_nodes, graph.n_edges, n_devices, dtype
+    )
     # Every exit publishes ONE strategy_decision event carrying the
     # measured inputs, so trace_report can show WHY a run picked its
     # strategy (ISSUE 9 satellite) — today the choice was invisible in
@@ -143,18 +173,28 @@ def auto_select_strategy(
     inputs = dict(
         devices=n_devices,
         nodes=graph.n_nodes, edges=graph.n_edges,
-        node_state_bytes=int(node_state), edge_state_bytes=int(edge_state),
+        replicated_state_bytes=replicated,
         hbm_bytes=int(hbm_bytes),
     )
-    if node_state + edge_state > hbm_bytes / 2:
-        obs.emit("strategy_decision", chosen="nodes_balanced",
+    if replicated > hbm_bytes / 2:
+        # Replicated state does not fit: owned slices + sparse boundary
+        # exchange (ISSUE 15) — O(n/d + H) state per chip where the older
+        # nodes_balanced layout still all_gathers O(n) bytes per step.
+        # The owned butterfly needs a power-of-two mesh (the same shapes
+        # the elastic shrink chain rebuilds at); a non-pow2 count keeps
+        # the legacy memory-scaling layout.
+        pow2 = n_devices >= 1 and n_devices & (n_devices - 1) == 0
+        obs.emit("strategy_decision",
+                 chosen="owned" if pow2 else "nodes_balanced",
                  reason="replicated node state exceeds half the per-chip "
                         "HBM budget", **inputs)
-        return "nodes_balanced"
+        return "owned" if pow2 else "nodes_balanced"
     # Replicated state fits — prefer the degree-aware hybrid layout when
     # the graph has a dense-worthy power-law head covering a meaningful
     # fraction of the edges (the dense MXU rows then carry the hot
-    # in-degree mass scatter-free); plain ``edges`` otherwise.
+    # in-degree mass scatter-free); plain ``edges`` otherwise.  A
+    # weighted graph never picks hybrid: its sharded form has no
+    # weighted dense rows (partition_graph would refuse).
     indeg = np.diff(graph.csr_indptr())
     # evaluate the head at the SAME knobs the partition will materialize
     # with — plan_hybrid_head's planner/builder agreement contract
@@ -165,7 +205,8 @@ def auto_select_strategy(
     head_edges = int(indeg[head_ids].sum()) if head_ids.size else 0
     inputs.update(head_nodes=int(head_ids.size), head_edges=head_edges,
                   head_edge_frac=round(head_edges / max(graph.n_edges, 1), 4))
-    if head_ids.size and head_edges >= graph.n_edges // 4:
+    if (head_ids.size and head_edges >= graph.n_edges // 4
+            and graph.weight is None):
         obs.emit("strategy_decision", chosen="hybrid",
                  reason="replicated state fits and the power-law head "
                         "covers >=25% of edges", **inputs)
@@ -200,7 +241,36 @@ class PartitionPlan(NamedTuple):
     # 'hybrid' only: (head node count, dense row width, total dense rows,
     # dense rows per device) — the head side of the slot accounting
     head: tuple[int, int, int, int] | None = None
+    # 'owned' only: the full boundary-exchange plan (ops.boundary.OwnedPlan
+    # — head set, tail bounds, boundary sets, pad + comm accounting);
+    # partition_graph materializes exactly it
+    owned: ob.OwnedPlan | None = None
+    # Array entries each device sends per iteration under this plan (the
+    # static per-step comm footprint — ICI bytes = entries * itemsize);
+    # published with the partition event and gauged by _ShardedExec so
+    # trace_diff can regress it across rounds (ISSUE 15 satellite).
+    comm_entries_per_step: int | None = None
 
+
+
+def _comm_entries(strategy: str, d: int, n_pad: int, block: int,
+                  owned_plan: "ob.OwnedPlan | None" = None) -> int:
+    """Static per-step comm footprint of a partition plan, in array
+    entries sent per device per iteration (ring-scheduled collectives:
+    allreduce ~2 passes, gather/scatter ~1).  The replicated strategies
+    move O(n_pad) per step; ``owned`` moves only the padded boundary
+    buffers plus the head psum — the sublinearity the MULTICHIP scale
+    sweep measures."""
+    if d <= 1:
+        return 0
+    if strategy == "owned":
+        assert owned_plan is not None
+        return owned_plan.comm_entries_per_step()
+    if strategy in ("edges", "hybrid"):  # dense [n_pad] psum
+        return 2 * n_pad * (d - 1) // d
+    # nodes*/src*: all_gather / reduce-scatter of the block axis, plus
+    # two scalar psums (dangling mass + delta)
+    return (d - 1) * block + 4
 
 
 def _publish_plan(plan: PartitionPlan, n_devices: int) -> PartitionPlan:
@@ -208,11 +278,26 @@ def _publish_plan(plan: PartitionPlan, n_devices: int) -> PartitionPlan:
     it) as ONE obs event, so a trace explains the layout a run executed
     with (ISSUE 9 satellite: trace_report's strategy section).  No-op
     outside a traced run — the tier-3 lint calls plan_partition freely."""
+    plan = plan._replace(
+        comm_entries_per_step=_comm_entries(
+            plan.strategy, n_devices, plan.n_pad, plan.block, plan.owned
+        )
+    )
+    ow = plan.owned
     obs.emit(
         "partition_plan", strategy=plan.strategy, devices=n_devices,
         n=plan.n, n_pad=plan.n_pad, block=plan.block, e_dev=plan.e_dev,
         pad_frac=round(float(plan.pad_frac), 6),
         head=(list(plan.head) if plan.head is not None else None),
+        comm_entries_per_step=plan.comm_entries_per_step,
+        **(
+            dict(
+                owned_head=ow.h, owned_h_pad=ow.h_pad, owned_b_pad=ow.b_pad,
+                boundary_total=int(ow.boundary_counts.sum()),
+                boundary_pad_frac=round(float(ow.boundary_pad_frac), 6),
+            )
+            if ow is not None else {}
+        ),
     )
     return plan
 
@@ -224,16 +309,30 @@ def plan_partition(
     strategy: str = "edges",
     head_coverage: float = 0.5,
     head_row_width: int = 128,
+    owned_max_head: int = 4096,
 ) -> PartitionPlan:
     """Plan a partition without building it: boundaries, padded widths and
     ``pad_frac`` only — O(E) host work, no per-device arrays, no device
     traffic.  ``partition_graph`` materializes exactly this plan."""
     if strategy not in ("edges", "nodes", "nodes_balanced", "src", "src_ring",
-                        "hybrid"):
+                        "hybrid", "owned"):
         raise ValueError(f"unknown shard strategy {strategy!r}")
     d = n_devices
     n = graph.n_nodes
     e = graph.n_edges
+
+    if strategy == "owned":
+        # The whole boundary-exchange plan lives in ops.boundary (head
+        # set, min-max tail bounds, per-owner boundary sets, pad + comm
+        # accounting); this wrapper only adapts it to the PartitionPlan
+        # introspection surface the tier-3 pad gauge budgets.
+        op = ob.plan_owned(graph, d, coverage=head_coverage,
+                           max_head=owned_max_head)
+        return _publish_plan(
+            PartitionPlan(strategy, n, op.n_pad, op.block, op.e_dev,
+                          op.pad_frac, owned=op),
+            d,
+        )
 
     if strategy == "hybrid":
         # Replicated-state layout: head rows and tail edges both split at
@@ -364,6 +463,10 @@ class ShardedGraph(NamedTuple):
     # vector; all-sentinel padding rows scatter 0.0 into node 0.
     head_src: np.ndarray | None = None  # int32 [D, R_dev, W]
     head_node: np.ndarray | None = None  # int32 [D, R_dev] global dst ids
+    # 'owned' only: the materialized boundary-exchange layout (every
+    # per-device array + the owned/replicated state vectors); the fields
+    # above hold placeholder shapes for that strategy
+    owned: ob.OwnedShard | None = None
 
 
 def partition_graph(
@@ -375,6 +478,7 @@ def partition_graph(
     need_local_indptr: bool = True,
     head_coverage: float = 0.5,
     head_row_width: int = 128,
+    owned_max_head: int = 4096,
 ) -> ShardedGraph:
     """Partition once on host (the reference partitions on every shuffle).
 
@@ -385,10 +489,20 @@ def partition_graph(
 
     All split boundaries, padded widths and ``pad_frac`` come from
     :func:`plan_partition` — the static plan the tier-3 cost linter
-    budgets is the one this function materializes."""
+    budgets is the one this function materializes.
+
+    A weighted graph rides for free in every edge-mask strategy: the
+    ``valid`` mask slots carry the edge WEIGHT instead of 1.0 (padding
+    stays 0), so the per-edge product the step already computes becomes
+    the weighted SpMV; ``inv_outdeg`` normalizes by out-strength.  The
+    ``owned`` layout threads weights through its own coefficient arrays.
+    Only ``hybrid`` refuses weights sharded (its dense head rows are
+    weightless by construction — use another strategy or single-chip
+    hybrid)."""
     plan = plan_partition(graph, n_devices, strategy=strategy,
                           head_coverage=head_coverage,
-                          head_row_width=head_row_width)
+                          head_row_width=head_row_width,
+                          owned_max_head=owned_max_head)
     d = n_devices
     n = graph.n_nodes
     e = graph.n_edges
@@ -396,9 +510,28 @@ def partition_graph(
         plan.block, plan.n_pad, plan.e_dev, plan.pad_frac
     )
 
-    inv_g = np.where(
-        graph.out_degree > 0, 1.0 / np.maximum(graph.out_degree, 1), 0.0
-    ).astype(dtype)
+    if strategy == "owned":
+        shard = ob.build_owned_shard(graph, plan.owned, dtype)
+        ph = np.zeros((d, 1), np.int32)  # legacy-field placeholders
+        return ShardedGraph(
+            strategy, n, plan.n_pad, plan.block,
+            src=ph, dst=ph, valid=np.zeros((d, 1), dtype),
+            inv_outdeg=shard.inv_tail, dangling=shard.dang_tail,
+            pad_frac=pad_frac, node_map=np.arange(n, dtype=np.int64),
+            local_indptr=ph, owned=shard,
+        )
+
+    weighted = graph.weight is not None
+    if weighted and strategy == "hybrid":
+        raise NotImplementedError(
+            "sharded strategy 'hybrid' has no weighted-edge form (the "
+            "dense head rows carry no weight matrix); use 'owned', "
+            "'edges' or a node strategy for weighted graphs"
+        )
+    # the per-edge coefficient the valid mask carries: weight or 1.0
+    ew = graph.weight if weighted else None
+
+    inv_g = graph.inv_out_strength(dtype)
     dang_g = (graph.out_degree == 0).astype(dtype)
 
     if strategy == "hybrid":
@@ -458,6 +591,7 @@ def partition_graph(
         order = np.lexsort((graph.dst, owner))  # by device, then dst-sorted
         src_o = graph.src[order]
         dst_o = graph.dst[order]
+        ew_o = ew[order] if weighted else None
         per = plan.per
         starts = np.concatenate([[0], np.cumsum(per)])
         src_l = np.zeros((d, e_dev), np.int32)
@@ -468,7 +602,7 @@ def partition_graph(
             k = hi - lo
             src_l[i, :k] = src_o[lo:hi] - i * block  # block-local sources
             dst2[i, :k] = dst_o[lo:hi]
-            valid[i, :k] = 1.0
+            valid[i, :k] = ew_o[lo:hi] if weighted else 1.0
         inv = np.zeros(n_pad, dtype)
         inv[:n] = inv_g
         dangling = np.zeros(n_pad, dtype)
@@ -496,7 +630,7 @@ def partition_graph(
         valid = np.zeros(cap, dtype)
         src[:e] = graph.src
         dst[:e] = graph.dst
-        valid[:e] = 1.0
+        valid[:e] = ew if weighted else 1.0
         inv = np.zeros(n_pad, dtype)
         inv[:n] = inv_g
         dangling = np.zeros(n_pad, dtype)
@@ -545,7 +679,7 @@ def partition_graph(
         k = hi - lo
         src[i, :k] = src_mapped[lo:hi]
         dst_local[i, :k] = graph.dst[lo:hi] - bounds_nodes[i]
-        valid[i, :k] = 1.0
+        valid[i, :k] = ew[lo:hi] if weighted else 1.0
     inv = np.zeros(n_pad, dtype)
     inv[node_map] = inv_g
     dangling = np.zeros(n_pad, dtype)
@@ -593,11 +727,100 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
             f"spmv_impl={cfg.spmv_impl!r} is not wired into the sharded "
             "runner; use 'segment', 'cumsum' or 'cumsum_mxu' with --mesh"
         )
+    if sg.strategy == "owned" and cfg.spmv_impl != "segment":
+        raise NotImplementedError(
+            "the owned strategy reduces its tail through the sorted "
+            "segment path; use spmv_impl='segment'"
+        )
     axis = mesh.axis_names[0]
     damping = cfg.damping
     total_mass = float(sg.n) if cfg.init is RankInit.ONE else 1.0
     redistribute = cfg.dangling is DanglingMode.REDISTRIBUTE
     n_pad, block = sg.n_pad, sg.block
+
+    if sg.strategy == "owned":
+        # Owned slices + sparse boundary exchange (ISSUE 15; module
+        # docstring + ops/boundary.py).  Per step and per device, the ONLY
+        # collectives are the log2(d) ppermute rounds of the boundary
+        # butterfly and ONE [H_pad+2] psum combining the head partials —
+        # whose two spare slots also carry the dangling-mass partial and
+        # the PREVIOUS step's local tail delta, so neither needs a psum of
+        # its own.  The global convergence gauge therefore lags one
+        # iteration (a tolerance run does at most one extra step; ranks
+        # are exact either way), which is the price of the
+        # log2(d)-ppermute + 1-psum collective budget the registry
+        # enforces.  The rank carry is a 4-tuple
+        # (tail [n_pad] sharded, head [h_pad] replicated,
+        #  dslot [d] sharded, gdelta [] replicated) and is DONATED.
+        shard = sg.owned
+        h_pad, d_ax = shard.h_pad, shard.d
+        inv_d = 1.0 / d_ax  # d is pow2: exact in binary fp
+
+        def step(carry, tsrc, tdst, tw, hsrc, hslot, hw, out_idx,
+                 inv_t, dang_t, inv_h, dang_h, e_t, e_h):
+            tail, head, dslot, _gd = carry
+            wt = tail * inv_t  # [block] local weighted ranks
+            wh = head * inv_h  # [h_pad] replicated weighted head
+            btable = coll.butterfly_all_gather(
+                ob.pack_boundary(wt, out_idx[0]), axis
+            )  # [d*b_pad]: every shard's outgoing boundary values
+            lookup = ob.boundary_lookup(wt, btable, wh)
+            tail_contrib = jax.ops.segment_sum(
+                lookup[tsrc[0]] * tw[0], tdst[0],
+                num_segments=block, indices_are_sorted=True,
+            )
+            buf = jax.ops.segment_sum(
+                lookup[hsrc[0]] * hw[0], hslot[0],
+                num_segments=h_pad + 2, indices_are_sorted=True,
+            )
+            if redistribute:
+                # head part is replicated: each device contributes 1/d of
+                # it so the psum restores exactly one copy (d pow2 ⇒ the
+                # scale round-trips exactly)
+                buf = buf.at[h_pad].add(
+                    jnp.sum(tail * dang_t) + jnp.sum(head * dang_h) * inv_d
+                )
+            buf = buf.at[h_pad + 1].add(dslot[0])
+            buf = coll.psum(buf, axis)  # THE one psum of the step
+            head_contrib = buf[:h_pad]
+            gdelta_prev = buf[h_pad + 1]
+            if redistribute:
+                dmass = buf[h_pad]
+                tail_contrib = tail_contrib + dmass * e_t
+                head_contrib = head_contrib + dmass * e_h
+            new_tail = (1.0 - damping) * total_mass * e_t + damping * tail_contrib
+            new_head = (1.0 - damping) * total_mass * e_h + damping * head_contrib
+            new_dslot = (
+                jnp.sum(jnp.abs(new_tail - tail))
+                + jnp.sum(jnp.abs(new_head - head)) * inv_d
+            )[None]
+            return new_tail, new_head, new_dslot, gdelta_prev
+
+        def owned_loop(carry0, *arrays):
+            return dataflow.iterate(
+                lambda c: step(c, *arrays), carry0,
+                iterations=cfg.iterations, tol=cfg.tol,
+                delta_fn=lambda new, old: new[3],
+            )
+
+        edge_spec = P(axis, None)
+        state_spec = (P(axis), P(), P(axis), P())
+        mapped = shard_map(
+            owned_loop,
+            mesh=mesh,
+            in_specs=(state_spec,
+                      edge_spec, edge_spec, edge_spec,  # tail edges
+                      edge_spec, edge_spec, edge_spec,  # head edges
+                      edge_spec,                        # out_idx
+                      P(axis), P(axis), P(), P(),       # inv/dang tail+head
+                      P(axis), P()),                    # e_tail, e_head
+            out_specs=(state_spec, P(), P()),
+            check_vma=False,
+        )
+        # the owned carry is donated: per-chip state is the strategy's
+        # whole point, so XLA must reuse the slice buffers in place
+        # (DONATED_CALLEES row 'owned_runner'; tier-3 verifies aliasing)
+        return jax.jit(mapped, donate_argnums=(0,))
 
     def local_reduce(per_edge, dst_row, ip_row, num_segments):
         """Per-device `reduceByKey` over its sorted edge slice: the shared
@@ -725,6 +948,23 @@ def make_sharded_runner(sg: ShardedGraph, cfg: PageRankConfig, mesh: Mesh):
 def device_put_sharded_graph(sg: ShardedGraph, mesh: Mesh):
     axis = mesh.axis_names[0]
     esh = NamedSharding(mesh, P(axis, None))
+    if sg.strategy == "owned":
+        shard = sg.owned
+        tsh = NamedSharding(mesh, P(axis))
+        rsh = NamedSharding(mesh, P())
+        return (
+            jax.device_put(shard.tail_src_idx, esh),
+            jax.device_put(shard.tail_dst, esh),
+            jax.device_put(shard.tail_w, esh),
+            jax.device_put(shard.head_src_idx, esh),
+            jax.device_put(shard.head_slot, esh),
+            jax.device_put(shard.head_w, esh),
+            jax.device_put(shard.out_idx, esh),
+            jax.device_put(shard.inv_tail, tsh),
+            jax.device_put(shard.dang_tail, tsh),
+            jax.device_put(shard.inv_head, rsh),
+            jax.device_put(shard.dang_head, rsh),
+        )
     # Node-state vectors follow the strategy: replicated under ``edges`` /
     # ``hybrid`` (the step reads the full vectors), node-sharded under
     # ``nodes`` (1/D per-chip HBM — the strategy's reason to exist).
@@ -760,18 +1000,57 @@ class _ShardedExec:
                 graph, self.d, strategy=strategy, dtype=cfg.dtype,
                 need_local_indptr=(
                     cfg.spmv_impl in ("cumsum", "cumsum_mxu")
-                    and strategy != "hybrid"
+                    and strategy not in ("hybrid", "owned")
                 ),
                 head_coverage=cfg.head_coverage,
                 head_row_width=cfg.head_row_width,
+                owned_max_head=cfg.owned_max_head,
             )
             self.dev = device_put_sharded_graph(self.sg, mesh)
+        # the static per-step exchange footprint: ICI bytes each device
+        # sends per iteration under this partition (the sublinearity gauge
+        # the MULTICHIP scale sweep + trace_diff comm gate consume)
+        item = np.dtype(cfg.dtype).itemsize
+        if self.sg.strategy == "owned":
+            sh = self.sg.owned
+            entries = ob.comm_entries_per_step(self.d, sh.b_pad, sh.h_pad)
+        else:
+            entries = _comm_entries(
+                self.sg.strategy, self.d, self.sg.n_pad, self.sg.block
+            )
+        self.comm_bytes_per_step = int(entries * item)
+        obs.gauge("pagerank.comm_bytes_per_step", self.comm_bytes_per_step)
         metrics.record(
             event="partition", strategy=strategy, devices=self.d,
-            block=self.sg.block, edges_per_device=int(self.sg.src.shape[1]),
+            block=self.sg.block, edges_per_device=int(
+                self.sg.owned.e_dev + self.sg.owned.he_dev
+                if self.sg.strategy == "owned" else self.sg.src.shape[1]
+            ),
             pad_frac=round(self.sg.pad_frac, 4), secs=t_part.elapsed,
+            comm_bytes_per_step=self.comm_bytes_per_step,
         )
         axis = mesh.axis_names[0]
+        self._cfg = cfg
+        self._metrics = metrics
+        if self.sg.strategy == "owned":
+            # owned-slice state: a (tail sharded, head replicated) pair
+            # behind the dataflow OwnedArray view, plus the lagged-delta
+            # carry slots put_ranks adds
+            shard = self.sg.owned
+            self._tail_sh = NamedSharding(mesh, P(axis))
+            self._repl_sh = NamedSharding(mesh, P())
+            self.state_sharding = self._tail_sh
+            self.olayout = OwnedArray.from_shard(
+                shard, tail_sharding=self._tail_sh,
+                head_sharding=self._repl_sh,
+            )
+            e_t, e_h = ob.split_global(
+                shard, ops.restart_vector(self.sg.n, cfg), cfg.dtype
+            )
+            self.e_vec = (jax.device_put(e_t, self._tail_sh),
+                          jax.device_put(e_h, self._repl_sh))
+            self.layout = None
+            return
         self.state_sharding = (
             NamedSharding(mesh, P())
             if self.sg.strategy in ("edges", "hybrid")
@@ -784,24 +1063,53 @@ class _ShardedExec:
         self.layout = PartitionedArray.from_plan(
             self.sg.n, self.sg.n_pad, self.sg.node_map, self.state_sharding
         )
-        self._cfg = cfg
-        self._metrics = metrics
 
     def make_runner(self, seg_cfg: PageRankConfig):
         return make_sharded_runner(self.sg, seg_cfg, self.mesh)
 
     def invoke(self, runner, rd):
+        if self.sg.strategy == "owned":
+            # The owned carry is DONATED: the delta fetch gets its own
+            # guarded site so a transient sync failure re-pulls the live
+            # OUTPUT scalar instead of letting the segment site's retry
+            # re-dispatch into the consumed carry (models/pagerank.py's
+            # pagerank_delta_sync discipline).
+            owned_runner = runner
+            rd, iters, delta = owned_runner(rd, *self.dev, *self.e_vec)
+            with obs.span("pagerank.delta_sync"):
+                delta = float(rx.device_get(
+                    delta, site="pagerank_delta_sync",
+                    metrics=self._metrics,
+                    checkpoint_dir=self._cfg.checkpoint_dir,
+                ))
+            return rd, iters, delta
         rd, iters, delta = runner(rd, *self.dev, self.e_vec)
         delta = float(delta)  # scalar fetch is the only reliable device sync
         return rd, iters, delta
 
     def put_ranks(self, ranks_g: np.ndarray):
         """Global [n] ranks -> padded, sharded device state."""
+        if self.sg.strategy == "owned":
+            arr = self.olayout.put(ranks_g, self._cfg.dtype)
+            # lagged-delta slots start at +inf so the gauge cannot read
+            # "converged" before the first real global delta arrives
+            dslot = jax.device_put(
+                np.full(self.d, np.inf, self._cfg.dtype), self._tail_sh
+            )
+            gdelta = jax.device_put(
+                np.asarray(np.inf, self._cfg.dtype), self._repl_sh
+            )
+            return (arr.tail, arr.head, dslot, gdelta)
         return self.layout.put(ranks_g, self._cfg.dtype).value
 
     def extract_np(self, rd) -> np.ndarray:
         """Padded device state -> global [n] ranks (checkpoint payload)."""
         with obs.span("pagerank.ckpt_pull"):
+            if self.sg.strategy == "owned":
+                return self.olayout.with_value(rd[0], rd[1]).pull(
+                    site="pagerank_ckpt_pull", metrics=self._metrics,
+                    checkpoint_dir=self._cfg.checkpoint_dir,
+                )
             return self.layout.with_value(rd).pull(
                 site="pagerank_ckpt_pull", metrics=self._metrics,
                 checkpoint_dir=self._cfg.checkpoint_dir,
@@ -1025,18 +1333,31 @@ def run_pagerank_sharded(
         # so the acknowledged loss cannot re-fire here
         with obs.span("pagerank.result_pull_rebuilt"):
             return rx.device_get(
-                rd2, site="pagerank_result_pull", metrics=metrics,
+                (rd2[0], rd2[1]) if strategy == "owned" else rd2,
+                site="pagerank_result_pull", metrics=metrics,
                 checkpoint_dir=cfg.checkpoint_dir,
             )
 
     with obs.span("pagerank.result_pull"):
+        # owned state is a (tail, head, dslot, gdelta) carry: only the
+        # two rank components cross D2H — the delta slots are scratch
+        pull_view = (
+            (ranks_dev[0], ranks_dev[1]) if strategy == "owned"
+            else ranks_dev
+        )
         ranks_np = rx.device_get(
-            ranks_dev, site="pagerank_result_pull", metrics=metrics,
+            pull_view, site="pagerank_result_pull", metrics=metrics,
             checkpoint_dir=cfg.checkpoint_dir,
             fallbacks=[(None, pull_rebuild)],
         )
     exec_ = exec_box["exec"]  # a rebuild rung may have swapped it
+    if strategy == "owned":
+        ranks_final = ob.merge_global(
+            exec_.sg.owned, ranks_np[0], ranks_np[1]
+        )
+    else:
+        ranks_final = ranks_np[exec_.sg.node_map]
     return PageRankResult(
-        ranks=ranks_np[exec_.sg.node_map], iterations=done,
+        ranks=ranks_final, iterations=done,
         l1_delta=last_delta, metrics=metrics,
     )

@@ -68,6 +68,10 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         "GRAFT_SEG_BUDGET_S",  # tools/ci.sh wall-clock budget for the
         # segment-smoke gate (ingest → seal → query-from-new-segment →
         # merge under chaos; read in bash; default 15s)
+        "GRAFT_OWNED_BUDGET_S",  # tools/ci.sh wall-clock budget for the
+        # owned-strategy smoke (Zipf tolerance fixpoint on a 4-device
+        # mesh under *:fail@%5 chaos, single-chip parity asserted; read
+        # in bash; default 30s)
     }
 )
 
@@ -279,6 +283,11 @@ class PageRankConfig:
     # spmv_impl="sort_shuffle": bucket width each destination's edge run is
     # padded to (the factor the dynamic reduction shrinks by).
     shuffle_bucket_width: int = 8
+    # Sharded strategy="owned" (ISSUE 15): cap on the replicated hub-head
+    # size — the head mini-state and its per-step psum are O(head), so
+    # this bounds both; head_coverage doubles as the endpoint-coverage
+    # target of the combined-degree head policy (ops.boundary).
+    owned_max_head: int = 4096
     dtype: str = "float32"
     # Checkpoint every k iterations (0 = off) into checkpoint_dir.
     checkpoint_every: int = 0
@@ -310,6 +319,10 @@ class PageRankConfig:
             raise ValueError(
                 "head_row_width must be >= 8 and shuffle_bucket_width >= 2, "
                 f"got {self.head_row_width}/{self.shuffle_bucket_width}"
+            )
+        if self.owned_max_head < 0:
+            raise ValueError(
+                f"owned_max_head must be >= 0, got {self.owned_max_head}"
             )
         if self.spark_exact and self.spmv_impl not in ("segment", "bcoo"):
             # spark_exact's presence test counts unit contributions through

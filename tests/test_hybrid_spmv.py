@@ -90,7 +90,7 @@ def test_layout_builders_handle_empty_graph():
     g = from_edges(np.empty(0, np.int64), np.empty(0, np.int64))
     hl = ops.build_hybrid_layout(g)
     assert hl.head_ids.size == 0 and hl.tail_src.size == 0
-    bucket_src, bucket_node = ops.build_shuffle_layout(g)
+    bucket_src, bucket_node, _ = ops.build_shuffle_layout(g)
     assert bucket_src.shape[0] == 0 and bucket_node.size == 0
 
 
@@ -116,7 +116,7 @@ def test_hybrid_layout_accounts_every_edge_once():
 
 def test_shuffle_layout_accounts_every_edge_once():
     g = synthetic_powerlaw(150, 900, seed=4)
-    bucket_src, bucket_node = ops.build_shuffle_layout(g, bucket_width=8)
+    bucket_src, bucket_node, _ = ops.build_shuffle_layout(g, bucket_width=8)
     assert (np.diff(bucket_node) >= 0).all()
     pairs = []
     for row, dst in zip(bucket_src, bucket_node):
@@ -229,5 +229,6 @@ def test_auto_select_prefers_hybrid_for_powerlaw_heads():
     n = 400
     ring = from_edges(np.arange(n), (np.arange(n) + 1) % n)
     assert auto_select_strategy(ring, 8) == "edges"
-    # starved budget still picks the memory-scaling layout
-    assert auto_select_strategy(g, 8, hbm_bytes=10_000) == "nodes_balanced"
+    # starved budget picks the owned-slices layout (ISSUE 15 trigger:
+    # replicated state doesn't fit)
+    assert auto_select_strategy(g, 8, hbm_bytes=10_000) == "owned"

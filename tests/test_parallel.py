@@ -34,7 +34,8 @@ def single_chip_ranks(graph):
 @pytest.mark.parametrize("n_devices", [1, 2, 4, 8])
 @pytest.mark.parametrize(
     "strategy",
-    ["edges", "nodes", "nodes_balanced", "src", "src_ring", "hybrid"])
+    ["edges", "nodes", "nodes_balanced", "src", "src_ring", "hybrid",
+     "owned"])
 def test_chip_count_invariance(graph, single_chip_ranks, n_devices, strategy):
     res = run_pagerank_sharded(graph, CFG, n_devices=n_devices, strategy=strategy)
     assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9
@@ -197,8 +198,9 @@ def test_auto_select_strategy(graph, single_chip_ranks):
     # hub-heavy powerlaw graph, generous budget -> degree-aware 'hybrid'
     # (the no-head and starved-budget pins live in test_hybrid_spmv.py)
     assert auto_select_strategy(graph, 8) == "hybrid"
-    # starved budget -> memory-scaling layout
-    assert auto_select_strategy(graph, 8, hbm_bytes=10_000) == "nodes_balanced"
+    # starved budget -> the owned-slices layout (ISSUE 15: replicated-
+    # state-doesn't-fit is the owned trigger)
+    assert auto_select_strategy(graph, 8, hbm_bytes=10_000) == "owned"
     res = run_pagerank_sharded(graph, CFG, n_devices=4, strategy="auto")
     assert any(r.get("event") == "auto_strategy" for r in res.metrics.records)
     assert np.abs(res.ranks - single_chip_ranks).sum() <= 1e-9

@@ -335,7 +335,8 @@ def test_sharded_entries_trace_the_shrink_chain():
         if ep.name.startswith("pagerank_sharded")
         or ep.name == "tfidf_sharded_ingest"
     ]
-    assert len(sharded) == 5  # edges/nodes_balanced/src/hybrid + tfidf
+    # edges/nodes_balanced/src/hybrid/owned + tfidf
+    assert len(sharded) == 6
     for ep in sharded:
         t = ep.build()
         labels = [label for label, _ in t.variants]

@@ -84,17 +84,24 @@ np.testing.assert_allclose(res.ranks, base_pr.ranks, atol=1e-6)
 elastic.reset_health()  # fresh loss for the second runner
 tf = run_tfidf_sharded(iter(chunks), TfidfConfig(vocab_bits=10), n_devices=2)
 np.testing.assert_allclose(tf.to_dense(), base_tf.to_dense(), atol=1e-6)
+
+# the owned strategy (ISSUE 15): the shrink rung must re-own the rank
+# slices and rebuild the boundary sets for the surviving mesh
+elastic.reset_health()
+res_o = run_pagerank_sharded(g, PageRankConfig(iterations=10, **kw),
+                             n_devices=2, strategy="owned")
+np.testing.assert_allclose(res_o.ranks, base_pr.ranks, atol=1e-6)
 obs.end_run()
 
 rep = trace_report.report(glob.glob(
     os.path.join(os.environ["SCENARIO_DIR"], "chaos_device_lost.*.trace.jsonl")
 )[0])
 shrinks = rep["mesh_shrinks"]
-assert len(shrinks) == 2, shrinks  # one per runner
+assert len(shrinks) == 3, shrinks  # one per runner (pagerank/tfidf/owned)
 for s in shrinks:
     assert (s["devices_old"], s["devices_new"]) == (2, 1), s
 assert not rep["exhausted"], rep["exhausted"]
-print("device_lost scenario: OK — both sharded runners survived via "
+print("device_lost scenario: OK — all three sharded runners survived via "
       f"mesh-shrink ({[s['site'] for s in shrinks]})")
 EOF
 

@@ -25,6 +25,15 @@ blocks inside ``extra.served_qps`` for older rounds): a batch size whose
 served p99 regressed past ``--threshold`` (over the same jitter floor)
 fails the diff, and a round losing its served numbers is flagged.
 
+Since ISSUE 15 it also covers the sharded strategies' **per-step comm
+bytes** (``extra.comm_bytes_per_step``: the static exchange footprint
+the partition event gauges, keyed per strategy/scale point): a point
+whose bytes grew RELATIVELY past ``--threshold`` (over an absolute
+floor — pow2 boundary-buffer widths legitimately jump in small steps)
+fails the diff.  Rounds BEFORE the gauge existed carry no map, so the
+old-round fallback skips cleanly; a new round losing the map while the
+old one had it is flagged like the other gates.
+
 Stdlib-only (importable from the jax-free bench parent, same rule as
 trace_report.py).
 
@@ -191,6 +200,64 @@ def diff_served(
     return rows
 
 
+# Minimum absolute growth (bytes/step) a comm regression must also clear:
+# the pow2-padded boundary buffers legitimately step in small jumps when
+# the cut drifts a little between rounds.
+COMM_MIN_DELTA_BYTES = 4096
+
+
+def load_comm_bytes(path: str) -> dict | None:
+    """Per-point comm-bytes map (``{"owned-d8": bytes, ...}``) riding a
+    BENCH round's ``extra.comm_bytes_per_step``; None when the artifact
+    carries none (raw traces, pre-ISSUE-15 rounds) — the old-round
+    fallback that lets the gate arm on the first new round."""
+    if path.endswith(".jsonl"):
+        return None
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    comm = record.get("extra", {}).get("comm_bytes_per_step")
+    if isinstance(comm, dict) and comm:
+        return {k: float(v) for k, v in comm.items() if v is not None}
+    return None
+
+
+def diff_comm(
+    old: dict | None, new: dict | None, threshold: float
+) -> list[dict]:
+    """Comm-bytes regression rows, mirroring the served-latency gate: a
+    strategy/scale point's per-step bytes grew relatively past
+    ``threshold`` AND past the absolute floor; a round losing the map
+    while the old one had it is flagged.  Points on one side only (a
+    changed scale matrix) are attribution, not regression."""
+    if old is None:
+        return []
+    if new is None:
+        return [{
+            "key": "comm.missing",
+            "old": "present",
+            "new": None,
+            "why": "the old round carried per-step comm bytes and the new "
+                   "one does not — the round lost its comm accounting",
+        }]
+    rows: list[dict] = []
+    for k in sorted(set(old) & set(new)):
+        o, n = old[k], new[k]
+        if n > o * (1.0 + threshold) and n - o > COMM_MIN_DELTA_BYTES:
+            rows.append({
+                "key": f"comm.{k}.bytes_per_step",
+                "old": o,
+                "new": n,
+                "why": f"per-step comm bytes at {k} grew "
+                       f"{n / max(o, 1e-9):.2f}x",
+            })
+    return rows
+
+
 def diff_slo(
     old: dict | None, new: dict | None, threshold: float
 ) -> list[dict]:
@@ -304,10 +371,13 @@ def main(argv: list[str] | None = None) -> int:
                         args.threshold)
     served_rows = diff_served(load_served_p99(args.old),
                               load_served_p99(args.new), args.threshold)
+    comm_rows = diff_comm(load_comm_bytes(args.old),
+                          load_comm_bytes(args.new), args.threshold)
     all_regressions = (
         [r["phase"] for r in regressions]
         + [r["key"] for r in slo_rows]
         + [r["key"] for r in served_rows]
+        + [r["key"] for r in comm_rows]
     )
     result = {
         "old": {"path": args.old, "kind": old_kind, "wall_secs": old_wall},
@@ -315,6 +385,7 @@ def main(argv: list[str] | None = None) -> int:
         "phases": rows,
         "slo": slo_rows,
         "served": served_rows,
+        "comm": comm_rows,
         "regressions": all_regressions,
         "worst_regression": all_regressions[0] if all_regressions else None,
     }
@@ -334,7 +405,7 @@ def main(argv: list[str] | None = None) -> int:
             mark = " <-- REGRESSED" if r["phase"] in result["regressions"] else ""
             print(f"{r['phase']:28s} {r['old_secs']:9.3f} {r['new_secs']:9.3f} "
                   f"{r['delta_secs']:+9.3f}  {rel}{mark}")
-        for r in slo_rows + served_rows:
+        for r in slo_rows + served_rows + comm_rows:
             print(f"{r['key']:28s} {r['old']!s:>9s} {r['new']!s:>9s}  "
                   f"{r['why']} <-- REGRESSED")
         if all_regressions:

@@ -559,7 +559,8 @@ def render_human(rep: dict[str, Any]) -> str:
             reason = d.get("reason", "")
             inputs = ", ".join(
                 f"{k}={d[k]}"
-                for k in ("devices", "nodes", "edges", "node_state_bytes",
+                for k in ("devices", "nodes", "edges",
+                          "replicated_state_bytes", "node_state_bytes",
                           "head_edge_frac")
                 if k in d
             )
