@@ -34,6 +34,7 @@ BENCH_NODES/EDGES/ITERS; skip sections with BENCH_SKIP_TFIDF=1.
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -1010,6 +1011,124 @@ def _measure_tfidf_sharded_traced(obs) -> dict:
             "backend": jax.default_backend()}
 
 
+def measure_autotuned_ab() -> dict:
+    """Autotuned-vs-default A/B arm (ISSUE 16).  The parent runs this
+    child TWICE — once with ``GRAFT_TUNED_PROFILE`` pointing at the
+    committed profile, once with it ``off`` — and divides the arms into
+    the ``autotuned_vs_default`` speedup keys.  The child itself only
+    resolves knobs through the production ladder
+    (``load_tuned_profile``/``tuned_config``): whatever the profile says
+    is what gets measured, exactly as a real runner would see it."""
+    from page_rank_and_tfidf_using_apache_spark_tpu import obs
+
+    with obs.run("autotuned_ab"):
+        return _measure_autotuned_ab_traced(obs)
+
+
+def _measure_autotuned_ab_traced(obs) -> dict:
+    import shutil
+
+    import jax
+
+    from page_rank_and_tfidf_using_apache_spark_tpu import serving
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
+        synthetic_powerlaw,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.io.text import (
+        iter_corpus_chunks,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import (
+        run_pagerank,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
+        run_tfidf,
+        run_tfidf_streaming,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+        PageRankConfig,
+        TfidfConfig,
+        load_tuned_profile,
+        tuned_config,
+    )
+
+    profile = load_tuned_profile()  # env-resolved: the arm under test
+    out: dict = {
+        "profile_loaded": profile is not None,
+        "profile_path": profile.path if profile else None,
+        "backend": jax.default_backend(),
+        "stream_tokens_per_sec": None,
+        "hybrid_iters_per_sec": None,
+        "served_qps": None,
+    }
+
+    # ragged corpus: the chunk-packing knob only matters when fixed
+    # doc-count chunks arrive half-full, so doc sizes are log-normal like
+    # real corpora (a constant-size corpus would hide the pack win)
+    rng = np.random.default_rng(SEED)
+    docs = []
+    for _ in range(1536):
+        n = int(np.clip(rng.lognormal(4.6, 0.9), 8, 1200))
+        docs.append(" ".join(f"w{rng.zipf(1.3) % 50_000}"
+                             for _ in range(n)))
+    n_tokens = sum(len(d.split()) for d in docs)
+
+    with obs.span("bench.ab_stream"):
+        cfg = tuned_config(TfidfConfig, profile, vocab_bits=16)
+        run_tfidf_streaming(iter_corpus_chunks(iter(docs), 96), cfg)  # warm
+        best = math.inf
+        for _ in range(2):
+            t0 = time.perf_counter()
+            run_tfidf_streaming(iter_corpus_chunks(iter(docs), 96), cfg)
+            best = min(best, time.perf_counter() - t0)
+        out["stream_tokens_per_sec"] = round(n_tokens / best, 1)
+
+    with obs.span("bench.ab_hybrid"):
+        graph = synthetic_powerlaw(20_000, 160_000, seed=SEED)
+        pcfg = tuned_config(PageRankConfig, profile, iterations=8,
+                            spmv_impl="hybrid")
+        run_pagerank(graph, pcfg)  # warm
+        best = math.inf
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run_pagerank(graph, pcfg)
+            best = min(best, time.perf_counter() - t0)
+        out["hybrid_iters_per_sec"] = round(pcfg.iterations / best, 2)
+
+    with obs.span("bench.ab_serve"):
+        idx_dir = tempfile.mkdtemp(prefix="bench_ab_idx_")
+        try:
+            tcfg = TfidfConfig(vocab_bits=14)
+            res = run_tfidf(docs[:512], tcfg)
+            serving.save_index(idx_dir, res, tcfg)
+            index = serving.load_index(idx_dir)
+            scfg = tuned_config(serving.ServeConfig, profile,
+                                top_k=10, scoring="impacted")
+            queries = [[f"w{rng.zipf(1.3) % 50_000}"
+                        for _ in range(int(rng.integers(2, 5)))]
+                       for _ in range(192)]
+            with serving.TfidfServer(index, scfg) as srv:
+                warm = [srv.submit([f"warmonly{i}"])
+                        for i in range(2 * scfg.max_batch)]
+                for p in warm:
+                    p.result(120.0)
+                best = math.inf
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    pend = [srv.submit(q) for q in queries]
+                    for p in pend:
+                        p.result(120.0)
+                    best = min(best, time.perf_counter() - t0)
+            out["served_qps"] = round(len(queries) / best, 2)
+        finally:
+            shutil.rmtree(idx_dir, ignore_errors=True)
+
+    log(f"[autotuned-ab] profile={'on' if profile else 'off'} "
+        f"stream={out['stream_tokens_per_sec']} tok/s "
+        f"hybrid={out['hybrid_iters_per_sec']} it/s "
+        f"served={out['served_qps']} qps")
+    return out
+
+
 # --------------------------------------------------------------------------
 # parent orchestration (NO jax imports in this section)
 # --------------------------------------------------------------------------
@@ -1120,6 +1239,39 @@ def _lint_clean() -> bool | None:
     if not clean:
         sys.stderr.write(proc.stdout[-2000:])
     return clean
+
+
+def _tuned_profile_snapshot(path: str) -> dict | None:
+    """Stdlib-only read of the committed tuned profile for the BENCH
+    record: provenance (backend stamp, git sha) plus the knob values the
+    children resolved through ``load_tuned_profile``.  None = no profile
+    committed; an unreadable one records its error instead of raising."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        return {"path": path, "error": f"{type(exc).__name__}: {exc}"}
+    return {
+        "path": path,
+        "backend": rec.get("backend"),
+        "git_sha": rec.get("git_sha"),
+        "created_wall": rec.get("created_wall"),
+        "knobs": rec.get("knobs"),
+    }
+
+
+def _ab_speedup(tuned: dict | None, default: dict | None,
+                key: str) -> float | None:
+    """tuned/default ratio for one A/B key; None unless both arms
+    produced a positive number (> 1.0 = the tuned profile wins)."""
+    if not tuned or not default:
+        return None
+    t, d = tuned.get(key), default.get(key)
+    if not t or not d or d <= 0:
+        return None
+    return round(float(t) / float(d), 3)
 
 
 def _run_child(mode: str, timeout_s: int, env: dict) -> dict | None:
@@ -1433,6 +1585,32 @@ def _main(graph_cache: str) -> int:
             int(os.environ.get("BENCH_OWNED_TIMEOUT_S", "900")), ow_env,
         )
 
+    # Autotuned-vs-default A/B (ISSUE 16): the same child twice, once
+    # resolving knobs through the committed tuned profile and once with
+    # the profile forced off — the ratio of the arms IS the measured
+    # value of the autotuner's output.  Runs only when a committed
+    # profile exists for the backend the candidates actually used; skip
+    # with BENCH_SKIP_AB=1.
+    ab_tuned_out = None
+    ab_default_out = None
+    ab_profile_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"tuned_profile_{backend_used if backend_used != 'unknown' else 'cpu'}.json",
+    )
+    if not os.environ.get("BENCH_SKIP_AB") and os.path.exists(ab_profile_path):
+        ab_timeout = int(os.environ.get("BENCH_AB_TIMEOUT_S", "600"))
+        # the arms run chaos-free: an A/B under injected faults measures
+        # the chaos plan, not the knobs (and a hang plan aimed at the
+        # resilience child would wedge both arms identically)
+        ab_env = dict(child_env)
+        ab_env.pop("GRAFT_CHAOS", None)
+        ab_tuned_out = _run_child(
+            "autotuned-ab", ab_timeout,
+            dict(ab_env, GRAFT_TUNED_PROFILE=ab_profile_path))
+        ab_default_out = _run_child(
+            "autotuned-ab", ab_timeout,
+            dict(ab_env, GRAFT_TUNED_PROFILE="off"))
+
     # --- sklearn anchor for TF-IDF (same corpus would be ideal but costs
     # parent time; a fixed-rate anchor is recorded by tools/ when needed) ---
     extra: dict = {"tpu_unreachable": not tpu_alive, "backend": backend_used,
@@ -1446,6 +1624,20 @@ def _main(graph_cache: str) -> int:
                    "sync_deadline_s": sync_deadline_s,
                    "sync_deadline_source": sync_deadline_source}
     extra["trace_parent"] = trace_parent
+    # Which tuned profile shaped this round (ISSUE 16): the committed
+    # per-backend artifact, read stdlib-only (the parent never imports
+    # the package).  Always present; null = no committed profile for the
+    # measured backend.  trace_diff flags a round whose profile backend
+    # stamp disagrees with the backend the candidates ran on.
+    extra["tuned_profile"] = _tuned_profile_snapshot(ab_profile_path)
+    # Autotuned-vs-default speedups (tuned arm / default arm, > 1 means
+    # the committed profile wins).  Keys are ALWAYS present so rounds
+    # stay comparable; null = that arm (or both) failed this round.
+    extra["autotuned_vs_default"] = {
+        key: _ab_speedup(ab_tuned_out, ab_default_out, key)
+        for key in ("stream_tokens_per_sec", "hybrid_iters_per_sec",
+                    "served_qps")
+    }
     # Always present so rounds are comparable: null = the serve child did
     # not produce a number this round.
     extra["served_qps"] = None
@@ -1609,6 +1801,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1] == "--workloads":
         print(json.dumps(measure_workloads()))
+        sys.exit(0)
+    if len(sys.argv) == 2 and sys.argv[1] == "--autotuned-ab":
+        print(json.dumps(measure_autotuned_ab()))
         sys.exit(0)
     if len(sys.argv) == 2 and sys.argv[1].startswith("--impl="):
         print(json.dumps(measure_impl(sys.argv[1].split("=", 1)[1])))

@@ -5,8 +5,8 @@ Usage::
     python -m page_rank_and_tfidf_using_apache_spark_tpu.analysis \
         [paths...] [--tier 1|2|3|4|5|all] [--changed-only [BASE]] [--json] \
         [--baseline FILE | --no-baseline] [--write-baseline] \
-        [--cost-report] [--lock-graph] [--crash-points] [--list-rules] \
-        [--list-entry-points]
+        [--cost-report] [--profile-report] [--lock-graph] [--crash-points] \
+        [--list-rules] [--list-entry-points]
 
 Tier 1 is the lexical AST rule set (stdlib-only; runs even when jax is
 broken).  Tier 2 traces the registered jit entry points on the CPU backend
@@ -82,6 +82,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--cost-report", action="store_true",
                     help="print the tier-3 per-entry cost table as JSON "
                          "(implies the tier-3 analysis ran)")
+    ap.add_argument("--profile-report", action="store_true",
+                    help="print the tier-3 autotuning report as JSON — "
+                         "declared domain vs tuned value vs hand-picked "
+                         "default, per knob per backend (implies the "
+                         "tier-3 analysis ran; this half is stdlib-only)")
     ap.add_argument("--lock-graph", action="store_true",
                     help="emit the tier-4 lock-acquisition graph as DOT "
                          "(embedded as JSON under --json); implies the "
@@ -119,6 +124,9 @@ def main(argv: list[str] | None = None) -> int:
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.persistence import (
             PERSIST_RULES,
         )
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis.profile import (
+            PROFILE_RULES,
+        )
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.semantic import (
             SEMANTIC_RULES,
         )
@@ -126,6 +134,8 @@ def main(argv: list[str] | None = None) -> int:
         for rid, summary in SEMANTIC_RULES.items():
             print(f"{rid:22s} [tier 2] {summary}")
         for rid, summary in COST_RULES.items():
+            print(f"{rid:22s} [tier 3] {summary}")
+        for rid, summary in PROFILE_RULES.items():
             print(f"{rid:22s} [tier 3] {summary}")
         for rid, summary in CONC_RULES.items():
             print(f"{rid:22s} [tier 4] {summary}")
@@ -150,7 +160,8 @@ def main(argv: list[str] | None = None) -> int:
     root = engine.repo_root()
     tier1 = args.tier in ("1", "all")
     tier2 = args.tier in ("2", "all")
-    tier3 = args.tier in ("3", "all") or args.cost_report
+    tier3 = args.tier in ("3", "all") or args.cost_report \
+        or args.profile_report
     tier4 = args.tier in ("4", "all") or args.lock_graph
     tier5 = args.tier in ("5", "all") or args.crash_points
 
@@ -232,8 +243,21 @@ def main(argv: list[str] | None = None) -> int:
         if sem:
             findings = engine.assign_fingerprints(list(findings) + sem)
 
+    profile_report: dict | None = None
     if tier3:
-        from page_rank_and_tfidf_using_apache_spark_tpu.analysis import cost
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis import (
+            cost,
+            profile,
+        )
+
+        # the profile-contract half first: stdlib-only, so its findings
+        # land even when the trace-based cost pass cannot bring jax up
+        pres = profile.run_profile(root=root, only_modules=only_modules)
+        if pres.findings:
+            findings = engine.assign_fingerprints(
+                list(findings) + pres.findings
+            )
+        profile_report = pres.report
 
         try:
             cres = cost.run_cost(root=root, only_modules=only_modules)
@@ -320,6 +344,11 @@ def main(argv: list[str] | None = None) -> int:
 
         print(_json.dumps(cost_report, indent=2))
 
+    if args.profile_report and profile_report is not None and not args.json:
+        import json as _json
+
+        print(_json.dumps(profile_report, indent=2))
+
     if args.lock_graph and lock_graph is not None and not args.json:
         print(lock_graph.to_dot())
 
@@ -334,6 +363,8 @@ def main(argv: list[str] | None = None) -> int:
             extra_json["advisories"] = [f.to_dict() for f in advisories]
         if args.cost_report and cost_report is not None:
             extra_json["cost_report"] = cost_report
+        if args.profile_report and profile_report is not None:
+            extra_json["profile_report"] = profile_report
         if args.lock_graph and lock_graph is not None:
             extra_json["lock_graph"] = lock_graph.to_json()
         if args.crash_points and crash_points is not None:

@@ -206,17 +206,29 @@ ARTIFACT_SCHEMAS: tuple = (
      ("tools/trace_report.py::stitch::man",
       "tools/trace_report.py::render_human::man"),
      ("name", "status", "pid", "argv", "python", "started_wall",
-      "trace_path", "git_sha", "lint_clean", "knobs", "backend", "devices",
+      "trace_path", "git_sha", "lint_clean", "knobs", "tuned_profile",
+      "backend", "devices",
       "device_count", "finished_wall", "wall_secs", "events", "summary"),
      # the SIGKILL-forensics payload: written for humans reading the file,
      # not reloaded by any code path
      ("argv", "python", "started_wall", "trace_path", "lint_clean",
-      "knobs", "devices", "device_count", "finished_wall", "wall_secs",
-      "events", "summary")),
+      "knobs", "tuned_profile", "devices", "device_count", "finished_wall",
+      "wall_secs", "events", "summary")),
     ("cost_artifact",
      (f"{_PKG}/utils/artifacts.py::write_artifact",),
      (f"{_PKG}/utils/artifacts.py::read_backend",),
      ("backend",),
+     ()),
+    # the autotuner's committed per-backend knob optimum (ISSUE 16):
+    # written durably by the config layer (stage + durable_replace, same
+    # provenance guard as the cost artifacts), loaded back through the one
+    # knob-resolution ladder every runner uses.  git_sha/created_wall/
+    # measured are sweep forensics — the loader carries them for manifests
+    # but no code path branches on them.
+    ("tuned_profile",
+     (f"{_PKG}/utils/config.py::write_tuned_profile",),
+     (f"{_PKG}/utils/config.py::load_tuned_profile::record",),
+     ("backend", "knobs", "git_sha", "created_wall", "measured"),
      ()),
 )
 
@@ -231,6 +243,65 @@ COMMIT_LOCKS: tuple = (
     # manifest generations are read-modify-write: an ingest append and a
     # background merge racing unserialized can resurrect replaced segments
     (f"{_PKG}/serving/segments.py", "_COMMIT_LOCK", ("_write_manifest",)),
+)
+
+# ---------------------------------------------------------------------------
+# Autotuning search-space contract (tier 3, ISSUE 16).
+#
+# ``TUNED_KNOBS`` declares the knob space ``tools/autotune.py`` sweeps and
+# the tier-3 ``profile-drift`` check gates: one row per tunable —
+# ``(knob name, candidate domain, affected registry entries)``.
+#
+# - the knob name must appear in ``utils/config.py``'s TUNABLE_DEFAULTS
+#   (the single source of hand-picked defaults — domains here deliberately
+#   do NOT repeat the default value's meaning; the default is always an
+#   implicit member of the search space);
+# - the domain is the full candidate grid the tuner enumerates BEFORE the
+#   static cost model prunes it (pad-plan/intensity budget violations are
+#   discarded unmeasured — the analysis is the search heuristic);
+# - affected entries name the ENTRY_POINTS rows whose pad-plan budgets
+#   prune this knob's candidates and whose microbenches score survivors.
+#
+# ``profile-drift`` validates the committed ``tuned_profile_<backend>.json``
+# artifacts against this table in both directions (stale knob, missing
+# backend stamp, out-of-domain value, declared-but-untuned), and validates
+# the table itself against TUNABLE_DEFAULTS and ENTRY_POINTS — the space
+# the tuner searches and the knobs the code reads cannot drift apart.
+# Parsed lexically — keep it a literal (plain int/float domain values).
+TUNED_KNOBS: tuple = (
+    # hybrid SpMV dense-head layout: candidates outside the entry's
+    # pad_frac ceiling (0.25) on the probe graph are pruned statically
+    ("head_coverage", (0.25, 0.5, 0.75),
+     ("pagerank_step_hybrid",)),
+    ("head_row_width", (64, 128, 256),
+     ("pagerank_step_hybrid",)),
+    # sort_shuffle bucket padding: wider buckets shrink the reduction but
+    # pay pad; the bucket pad fraction is computable without tracing
+    ("shuffle_bucket_width", (4, 8, 16),
+     ("pagerank_step_sort_shuffle",)),
+    # owned-strategy replicated hub-head cap (boundary pad ceiling 0.30)
+    ("owned_max_head", (1024, 4096, 8192),
+     ("pagerank_sharded_owned",)),
+    # staged ingest depths: scheduling-only (results bit-identical), so
+    # no pad model prunes them — they ride to measurement unless the
+    # paired pack target was already discarded
+    ("prefetch", (0, 2, 4),
+     ("tfidf_chunk_ingest_carry",)),
+    ("pipeline_depth", (0, 2, 4),
+     ("tfidf_chunk_ingest_carry",)),
+    # streaming chunk re-packing target: 0 (caller chunking as-is) and
+    # non-pow2 targets strand pad under the carried grow_chunk_cap pow2
+    # policy — provably over the 0.20 drain/carry ceiling, pruned unmeasured
+    ("pack_target_tokens", (0, 24000, 100000, 131072, 262144),
+     ("tfidf_chunk_drain", "tfidf_chunk_ingest_carry")),
+    # serving batch cap (query-batch pad ceiling 0.30)
+    ("max_batch", (4, 8, 16),
+     ("tfidf_score_query_batch",)),
+    # impacted-list scoring bucket layout (impacted pad ceiling 0.62)
+    ("impact_bucket_width", (4, 8, 16),
+     ("tfidf_score_impacted_batch",)),
+    ("impact_warm_buckets", (4096, 8192, 16384),
+     ("tfidf_score_impacted_batch",)),
 )
 
 # ``--tier all`` runs two analyzers (semantic + cost) over the same

@@ -21,7 +21,11 @@ from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import (
     synthetic_powerlaw,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.models.pagerank import run_pagerank
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import PageRankConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    PageRankConfig,
+    load_tuned_profile,
+    tuned_config,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder, Timer
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.profiling import trace
 
@@ -49,12 +53,19 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["segment", "bcoo", "cumsum", "cumsum_mxu",
                             "hybrid", "sort_shuffle", "pallas"],
                    default="segment")
-    p.add_argument("--head-coverage", type=float, default=0.5,
+    p.add_argument("--head-coverage", type=float, default=None,
                    help="hybrid impl/strategy: edge-coverage threshold of "
-                        "the dense high-in-degree head (default 0.5)")
-    p.add_argument("--head-row-width", type=int, default=128,
+                        "the dense high-in-degree head (default: tuned "
+                        "profile, then TUNABLE_DEFAULTS)")
+    p.add_argument("--head-row-width", type=int, default=None,
                    help="hybrid impl/strategy: dense row width (MXU lane "
-                        "count; adapts down on small graphs)")
+                        "count; adapts down on small graphs; default: tuned "
+                        "profile, then TUNABLE_DEFAULTS)")
+    p.add_argument("--tuned-profile", default=None, metavar="PATH",
+                   help="tuned-profile artifact to resolve unset knobs "
+                        "from ('off' disables profile loading; default: "
+                        "$GRAFT_TUNED_PROFILE, then the committed "
+                        "tuned_profile_<backend>.json)")
     p.add_argument("--dtype", default="float32")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--checkpoint-every", type=int, default=0)
@@ -102,7 +113,12 @@ def _main(args) -> int:
     metrics.record(event="load", nodes=graph.n_nodes, edges=graph.n_edges,
                    secs=t_load.elapsed)
 
-    cfg = PageRankConfig(
+    # knob resolution ladder: explicit flag > tuned profile (same-backend
+    # only, ProvenanceError otherwise) > TUNABLE_DEFAULTS
+    profile = (None if args.tuned_profile == "off"
+               else load_tuned_profile(path=args.tuned_profile))
+    cfg = tuned_config(
+        PageRankConfig, profile,
         iterations=args.iterations,
         damping=args.damping,
         tol=args.tol,

@@ -30,6 +30,10 @@ from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
     TfidfServer,
     load_index,
 )
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    load_tuned_profile,
+    tuned_config,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -43,8 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--queries", default="-",
                    help="file of queries, one per line ('-' = stdin)")
     p.add_argument("--top-k", type=int, default=10)
-    p.add_argument("--max-batch", type=int, default=8,
-                   help="micro-batch cap (padded shapes are powers of two)")
+    p.add_argument("--max-batch", type=int, default=None,
+                   help="micro-batch cap (padded shapes are powers of two; "
+                        "default: tuned profile, then TUNABLE_DEFAULTS)")
     p.add_argument("--max-query-terms", type=int, default=16)
     p.add_argument("--cache-size", type=int, default=1024,
                    help="hot-query LRU entries (0 disables)")
@@ -71,9 +76,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "the batch's query terms' posting runs from the "
                         "CSC-by-term layout (byte-equal results, work "
                         "proportional to the query, not the corpus)")
-    p.add_argument("--impact-bucket-width", type=int, default=8,
+    p.add_argument("--impact-bucket-width", type=int, default=None,
                    help="fixed bucket width the impacted planner pads "
-                        "posting runs to")
+                        "posting runs to (default: tuned profile, then "
+                        "TUNABLE_DEFAULTS)")
+    p.add_argument("--tuned-profile", default=None, metavar="PATH",
+                   help="tuned-profile artifact to resolve unset knobs "
+                        "from ('off' disables profile loading; default: "
+                        "$GRAFT_TUNED_PROFILE, then the committed "
+                        "tuned_profile_<backend>.json)")
     p.add_argument("--no-mmap", action="store_true",
                    help="copy the index into RAM instead of mapping it")
     p.add_argument("--trace-dir", default=None,
@@ -100,7 +111,12 @@ def _main(args) -> int:
     else:
         index = load_index(args.index, version=args.version,
                            mmap=not args.no_mmap)
-    cfg = ServeConfig(
+    # knob resolution ladder: explicit flag > tuned profile (same-backend
+    # only, ProvenanceError otherwise) > TUNABLE_DEFAULTS
+    profile = (None if args.tuned_profile == "off"
+               else load_tuned_profile(path=args.tuned_profile))
+    cfg = tuned_config(
+        ServeConfig, profile,
         top_k=args.top_k,
         max_batch=args.max_batch,
         max_query_terms=args.max_query_terms,

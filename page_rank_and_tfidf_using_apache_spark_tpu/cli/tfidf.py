@@ -27,7 +27,11 @@ from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
     run_tfidf,
     run_tfidf_streaming,
 )
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    TfidfConfig,
+    load_tuned_profile,
+    tuned_config,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.profiling import trace
 
@@ -60,18 +64,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --streaming: data-parallel ingest over this "
                         "many devices (the BASELINE config-5 'TPU mesh' "
                         "path); 0 = single device")
-    p.add_argument("--prefetch", type=int, default=2,
+    p.add_argument("--prefetch", type=int, default=None,
                    help="tokenizer chunks to double-buffer ahead of device "
-                        "compute (0 = serial)")
-    p.add_argument("--pipeline-depth", type=int, default=2,
+                        "compute (0 = serial; default: tuned profile, then "
+                        "TUNABLE_DEFAULTS)")
+    p.add_argument("--pipeline-depth", type=int, default=None,
                    help="H2D-staged chunks the ingest transfer thread may "
                         "hold in device memory — chunk N+1's device_put "
-                        "runs under chunk N's compute (0 = stage inline)")
-    p.add_argument("--pack-target", type=int, default=0, metavar="TOKENS",
+                        "runs under chunk N's compute (0 = stage inline; "
+                        "default: tuned profile, then TUNABLE_DEFAULTS)")
+    p.add_argument("--pack-target", type=int, default=None, metavar="TOKENS",
                    help="re-pack incoming chunks to ~TOKENS tokens each "
                         "before padding, so half-full chunks stop paying "
                         "full-cap compute (0 = keep the source chunking; "
-                        "resume runs must re-use the same value)")
+                        "resume runs must re-use the same value; default: "
+                        "tuned profile, then TUNABLE_DEFAULTS)")
+    p.add_argument("--tuned-profile", default=None, metavar="PATH",
+                   help="tuned-profile artifact to resolve unset knobs "
+                        "from ('off' disables profile loading; default: "
+                        "$GRAFT_TUNED_PROFILE, then the committed "
+                        "tuned_profile_<backend>.json)")
     p.add_argument("--save-index", default=None, metavar="DIR",
                    help="serialize the result as the next servable index "
                         "version under DIR (serving/artifact.py) — the "
@@ -119,7 +131,12 @@ def _main(args) -> int:
         names: list[str] = []
     else:
         docs, names = (load_corpus_lines if args.lines else load_corpus_dir)(args.input)
-    cfg = TfidfConfig(
+    # knob resolution ladder: explicit flag > tuned profile (same-backend
+    # only, ProvenanceError otherwise) > TUNABLE_DEFAULTS
+    profile = (None if args.tuned_profile == "off"
+               else load_tuned_profile(path=args.tuned_profile))
+    cfg = tuned_config(
+        TfidfConfig, profile,
         vocab_bits=args.vocab_bits,
         ngram=args.ngram,
         tf_mode=args.tf_mode,

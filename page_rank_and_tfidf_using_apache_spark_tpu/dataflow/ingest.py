@@ -439,7 +439,10 @@ def chunked_ingest(
     save_checkpoint: Callable[[], None] | None = None,
     prefetch_source: bool = True,
     stage: Callable | None = None,
-    pipeline_depth: int = 0,
+    # 0 is a semantic sentinel (inline staging, no transfer thread) — NOT
+    # the tuned pipeline depth; callers pass the resolved knob explicitly
+    # (or via ``ingest=``), so the ladder still reaches every real run
+    pipeline_depth: int = 0,  # graftlint: disable=untuned-knob-read
     ingest: IngestConfig | None = None,
     recover: Callable | None = None,
     retain_until_commit: bool = False,

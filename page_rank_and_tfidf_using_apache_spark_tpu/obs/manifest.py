@@ -72,6 +72,36 @@ def knob_snapshot() -> dict[str, str | None]:
     return {k: os.environ.get(k) for k in sorted(GRAFT_ENV_KNOBS)}
 
 
+def _tuned_profile_snapshot() -> dict[str, Any] | None:
+    """Provenance of the tuned profile this run resolved knobs from, or
+    None when no profile applies — a run manifest must say which tuned
+    values shaped it (the ``profile-drift`` tier-3 check audits committed
+    profiles; this records what a *specific run* actually saw).  A broken
+    or wrong-backend profile is itself evidence: record the error instead
+    of raising inside manifest writing."""
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.artifacts import (
+        ProvenanceError,
+    )
+    from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+        TunedProfileError,
+        load_tuned_profile,
+    )
+
+    try:
+        prof = load_tuned_profile()
+    except (TunedProfileError, ProvenanceError) as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    if prof is None:
+        return None
+    return {
+        "path": str(prof.path) if prof.path is not None else None,
+        "backend": prof.backend,
+        "git_sha": prof.git_sha,
+        "source": prof.source,
+        "knobs": dict(prof.knobs),
+    }
+
+
 def _atomic_write(path: str, doc: dict[str, Any]) -> None:
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
@@ -103,6 +133,7 @@ def write_manifest(
         "git_sha": _git_sha(),
         "lint_clean": None,  # filled by callers that ran the gate (bench.py)
         "knobs": knob_snapshot(),
+        "tuned_profile": _tuned_profile_snapshot(),
     }
     doc.update(_device_snapshot())
     if extra:

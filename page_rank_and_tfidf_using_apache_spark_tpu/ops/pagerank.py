@@ -38,6 +38,7 @@ import numpy as np
 from page_rank_and_tfidf_using_apache_spark_tpu.dataflow.fixpoint import iterate
 from page_rank_and_tfidf_using_apache_spark_tpu.io.graph import Graph
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    TUNABLE_DEFAULTS,
     DanglingMode,
     PageRankConfig,
     RankInit,
@@ -213,7 +214,10 @@ def build_hybrid_layout(
     )
 
 
-def build_shuffle_layout(graph: Graph, *, bucket_width: int = 8) -> tuple[
+def build_shuffle_layout(
+    graph: Graph, *,
+    bucket_width: int = TUNABLE_DEFAULTS["shuffle_bucket_width"],
+) -> tuple[
     np.ndarray, np.ndarray, np.ndarray | None
 ]:
     """One-time host pass for the sort-based static shuffle: pad every
@@ -251,9 +255,9 @@ def put_graph(
     dtype: str = "float32",
     *,
     layout: str | None = None,
-    head_coverage: float = 0.5,
-    head_row_width: int = 128,
-    bucket_width: int = 8,
+    head_coverage: float = TUNABLE_DEFAULTS["head_coverage"],
+    head_row_width: int = TUNABLE_DEFAULTS["head_row_width"],
+    bucket_width: int = TUNABLE_DEFAULTS["shuffle_bucket_width"],
     keep_edge_arrays: bool = True,
 ) -> DeviceGraph:
     """Host Graph → device arrays (one host→device transfer per run).

@@ -115,6 +115,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.resilience import elastic
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import executor as rx
 from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    TUNABLE_DEFAULTS,
     DanglingMode,
     PageRankConfig,
     RankInit,
@@ -147,8 +148,8 @@ def auto_select_strategy(
     *,
     dtype: str = "float32",
     hbm_bytes: int | None = None,
-    head_coverage: float = 0.5,
-    head_row_width: int = 128,
+    head_coverage: float = TUNABLE_DEFAULTS["head_coverage"],
+    head_row_width: int = TUNABLE_DEFAULTS["head_row_width"],
 ) -> str:
     """Pick a shard strategy by per-chip memory footprint.
 
@@ -307,9 +308,9 @@ def plan_partition(
     n_devices: int,
     *,
     strategy: str = "edges",
-    head_coverage: float = 0.5,
-    head_row_width: int = 128,
-    owned_max_head: int = 4096,
+    head_coverage: float = TUNABLE_DEFAULTS["head_coverage"],
+    head_row_width: int = TUNABLE_DEFAULTS["head_row_width"],
+    owned_max_head: int = TUNABLE_DEFAULTS["owned_max_head"],
 ) -> PartitionPlan:
     """Plan a partition without building it: boundaries, padded widths and
     ``pad_frac`` only — O(E) host work, no per-device arrays, no device
@@ -476,9 +477,9 @@ def partition_graph(
     strategy: str = "edges",
     dtype: str = "float32",
     need_local_indptr: bool = True,
-    head_coverage: float = 0.5,
-    head_row_width: int = 128,
-    owned_max_head: int = 4096,
+    head_coverage: float = TUNABLE_DEFAULTS["head_coverage"],
+    head_row_width: int = TUNABLE_DEFAULTS["head_row_width"],
+    owned_max_head: int = TUNABLE_DEFAULTS["owned_max_head"],
 ) -> ShardedGraph:
     """Partition once on host (the reference partitions on every shuffle).
 

@@ -87,7 +87,10 @@ from page_rank_and_tfidf_using_apache_spark_tpu.serving.segments import (
     SegmentSet,
     wrap_index_as_set,
 )
-from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import TfidfConfig
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    TUNABLE_DEFAULTS,
+    TfidfConfig,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import MetricsRecorder
 
 # Floor of the impacted-list bucket-count cap: the carried pow2 cap starts
@@ -102,7 +105,8 @@ class ServeConfig:
     index artifact's TfidfConfig — a server never re-interprets weights)."""
 
     top_k: int = 10
-    max_batch: int = 8  # micro-batch cap; padded shapes are pow2 <= this
+    # micro-batch cap; padded shapes are pow2 <= this
+    max_batch: int = TUNABLE_DEFAULTS["max_batch"]
     max_query_terms: int = 16  # Q: fixed per-query sparse slot count
     queue_depth: int = 64  # bound on submitted-but-undrained requests
     flush_ms: float = 2.0  # how long the drain waits to fill a batch
@@ -116,9 +120,11 @@ class ServeConfig:
     scoring: str = "coo"  # "coo" (full-postings batch scatter/gather) or
     # "impacted" (CSC-by-term run slicing — work ∝ the query's terms'
     # posting runs; byte-equal results, latency-shaped cost)
-    impact_bucket_width: int = 8  # fixed bucket width W the impacted
-    # planner pads posting runs to (sort_shuffle's bucket trick)
-    impact_warm_buckets: int = 1 << 13  # ceiling on the bucket cap the
+    # fixed bucket width W the impacted planner pads posting runs to
+    # (sort_shuffle's bucket trick)
+    impact_bucket_width: int = TUNABLE_DEFAULTS["impact_bucket_width"]
+    impact_warm_buckets: int = TUNABLE_DEFAULTS[
+        "impact_warm_buckets"]  # ceiling on the bucket cap the
     # warmup PRE-GROWS to (sized from the live set's heaviest posting
     # runs): a cap bump is a recompile ON the serving path, so warmup
     # sizes the carried cap for the worst plausible batch up front —
@@ -178,7 +184,8 @@ def batch_shape_matrix(max_batch: int) -> list[int]:
 
 
 def serve_pad_plan(
-    batch_sizes: Sequence[int], max_batch: int = 8
+    batch_sizes: Sequence[int],
+    max_batch: int = TUNABLE_DEFAULTS["max_batch"],
 ) -> list[tuple[str, float]]:
     """Static padding-waste plan of the serving micro-batcher: run raw
     batch sizes through the REAL :func:`batch_cap` policy and return

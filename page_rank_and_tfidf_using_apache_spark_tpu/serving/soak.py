@@ -89,9 +89,12 @@ from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
     segments as sgm,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    TUNABLE_DEFAULTS,
     Bm25Config,
     PageRankConfig,
     TfidfConfig,
+    load_tuned_profile,
+    tuned_config,
 )
 from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import (
     MetricsRecorder,
@@ -128,7 +131,7 @@ class SoakConfig:
     chunk_tokens: int = 1 << 12
     bootstrap_chunks: int = 3
     top_k: int = 10
-    max_batch: int = 8
+    max_batch: int = TUNABLE_DEFAULTS["max_batch"]
     prior_alpha: float = 0.25
     prior_iters: int = 5
     scoring: str = "coo"  # serving path (byte-equal either way).  The
@@ -255,10 +258,14 @@ class _Soak:
         under one identical config (one config hash) or the server would
         refuse — or worse, silently change semantics — mid-soak."""
         cfg = self.cfg
-        return TfidfConfig(
+        # prefetch/pipeline_depth resolve through the knob ladder (tuned
+        # profile for this backend, else TUNABLE_DEFAULTS) — not re-stated
+        # here; pack_target stays pinned to the soak's chunk size (resume
+        # discipline: packed chunk indices must be stable across rebuilds)
+        return tuned_config(
+            TfidfConfig, load_tuned_profile(),
             vocab_bits=cfg.vocab_bits, chunk_tokens=cfg.chunk_tokens,
-            pack_target_tokens=cfg.chunk_tokens, prefetch=2,
-            pipeline_depth=2,
+            pack_target_tokens=cfg.chunk_tokens,
         )
 
     def _take_chunk(self, gen: Iterator[list[str]]) -> list[str]:

@@ -13,6 +13,11 @@ import dataclasses
 import enum
 import hashlib
 import json
+import os
+import pathlib
+import sys
+import tempfile
+import time
 from typing import Any
 
 
@@ -72,8 +77,46 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         # owned-strategy smoke (Zipf tolerance fixpoint on a 4-device
         # mesh under *:fail@%5 chaos, single-chip parity asserted; read
         # in bash; default 30s)
+        "GRAFT_TUNE_BUDGET_S",  # tools/autotune.py wall-clock budget for
+        # the measured sweep over cost-model survivors (also the ci.sh
+        # autotune-smoke budget; default 60s — the pruned grid must fit)
+        "GRAFT_TUNED_PROFILE",  # path to a tuned_profile_<backend>.json
+        # the knob resolution ladder loads instead of the committed
+        # per-backend default ("off" or empty disables profile loading
+        # entirely: every knob falls back to TUNABLE_DEFAULTS)
     }
 )
+
+
+# Single source of truth for every hand-picked performance-knob default.
+# The dataclass fields below, the call-site signature defaults in
+# ops//parallel//serving//dataflow/, and the ``TUNED_KNOBS`` search-space
+# contract (analysis/registry.py) all read THIS table — the default-drift
+# hazard ISSUE 16 closes was the same constant spelled independently at
+# each of those sites.  graftlint tier 3's ``untuned-knob-read`` fails on
+# any bare-literal default for one of these names in models//parallel//
+# serving//dataflow/, and ``profile-drift`` cross-checks the table against
+# the committed tuned profiles.  Parsed lexically by the linter — keep it
+# a literal (plain int/float values, no expressions).
+TUNABLE_DEFAULTS: dict = {
+    # hybrid SpMV dense-head layout (ops/pagerank.py, PageRankConfig)
+    "head_coverage": 0.5,
+    "head_row_width": 128,
+    # sort_shuffle bucket padding (ops/pagerank.py build_shuffle_layout)
+    "shuffle_bucket_width": 8,
+    # strategy="owned" replicated hub-head cap (parallel/pagerank_sharded.py)
+    "owned_max_head": 4096,
+    # staged ingest pipeline depths (dataflow/ingest.py, IngestConfig)
+    "prefetch": 2,
+    "pipeline_depth": 2,
+    # streaming chunk re-packing target (models/tfidf.py; 0 = as-is)
+    "pack_target_tokens": 0,
+    # serving batch cap (serving/server.py ServeConfig, serving/soak.py)
+    "max_batch": 8,
+    # impacted-list scoring bucket layout (serving/server.py)
+    "impact_bucket_width": 8,
+    "impact_warm_buckets": 8192,  # 1 << 13
+}
 
 
 # The degradation rungs a guarded path may take past retry, declared in one
@@ -189,8 +232,8 @@ class IngestConfig:
     Results are bit-identical at every depth — only scheduling changes.
     """
 
-    prefetch: int = 2
-    pipeline_depth: int = 2
+    prefetch: int = TUNABLE_DEFAULTS["prefetch"]
+    pipeline_depth: int = TUNABLE_DEFAULTS["pipeline_depth"]
 
     def __post_init__(self) -> None:
         if self.prefetch < 0:
@@ -278,16 +321,16 @@ class PageRankConfig:
     # in-degree set covering ~head_coverage of all edges (every member's
     # in-degree >= the dense row width, which adapts down from
     # head_row_width on small graphs).
-    head_coverage: float = 0.5
-    head_row_width: int = 128
+    head_coverage: float = TUNABLE_DEFAULTS["head_coverage"]
+    head_row_width: int = TUNABLE_DEFAULTS["head_row_width"]
     # spmv_impl="sort_shuffle": bucket width each destination's edge run is
     # padded to (the factor the dynamic reduction shrinks by).
-    shuffle_bucket_width: int = 8
+    shuffle_bucket_width: int = TUNABLE_DEFAULTS["shuffle_bucket_width"]
     # Sharded strategy="owned" (ISSUE 15): cap on the replicated hub-head
     # size — the head mini-state and its per-step psum are O(head), so
     # this bounds both; head_coverage doubles as the endpoint-coverage
     # target of the combined-degree head policy (ops.boundary).
-    owned_max_head: int = 4096
+    owned_max_head: int = TUNABLE_DEFAULTS["owned_max_head"]
     dtype: str = "float32"
     # Checkpoint every k iterations (0 = off) into checkpoint_dir.
     checkpoint_every: int = 0
@@ -364,8 +407,8 @@ class TfidfConfig:
     # host syncs (prefetch), and how many H2D-staged chunks the transfer
     # thread may hold in device memory (pipeline_depth).  0/0 = fully
     # serial (tokenize → put → compute → pull, one chunk at a time).
-    prefetch: int = 2
-    pipeline_depth: int = 2
+    prefetch: int = TUNABLE_DEFAULTS["prefetch"]
+    pipeline_depth: int = TUNABLE_DEFAULTS["pipeline_depth"]
     # Re-pack incoming document chunks so each carries ~this many tokens
     # before padding (dataflow.ingest.pack_doc_chunks): the chunk kernel
     # sorts/reduces the PADDED arrays, so half-full chunks pay ~2x the
@@ -373,7 +416,7 @@ class TfidfConfig:
     # gap (BENCH_r07).  0 = take the caller's chunking as-is.  Documents
     # never split, so results are identical either way; checkpoint chunk
     # indices count PACKED chunks (resume with the same target).
-    pack_target_tokens: int = 0
+    pack_target_tokens: int = TUNABLE_DEFAULTS["pack_target_tokens"]
     checkpoint_every: int = 0  # chunks between checkpoints (0 = off)
     checkpoint_dir: str | None = None
     dtype: str = "float32"
@@ -509,3 +552,229 @@ def _hash_config(cfg: Any, exclude: set[str] = frozenset()) -> str:
     one semantic configuration (SURVEY.md §5.4)."""
     d = {k: v for k, v in _to_jsonable(cfg).items() if k not in exclude}
     return hashlib.sha256(json.dumps(d, sort_keys=True).encode()).hexdigest()[:16]
+
+
+# --------------------------------------------------------------------------
+# Tuned-profile artifact (ISSUE 16): the committed per-backend knob optimum
+# tools/autotune.py measures.  Spark counterpart: a tuned ``spark.conf``
+# shipped alongside the job — platform-specific values for the same named
+# knobs the code reads through one resolution ladder.
+# --------------------------------------------------------------------------
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+class TunedProfileError(ValueError):
+    """A tuned-profile artifact failed structural validation."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedProfile:
+    """One backend's measured knob optimum, as loaded from a
+    ``tuned_profile_<backend>.json`` artifact.
+
+    ``knobs`` maps TUNABLE_DEFAULTS names to the measured-best values;
+    ``measured`` carries the sweep evidence (bench keys and the speedup vs
+    defaults) for forensics.  ``source`` records which rung of the
+    resolution ladder produced this profile ("explicit" path argument,
+    "env" GRAFT_TUNED_PROFILE, or the "committed" per-backend default) —
+    run manifests persist it so a round's numbers are attributable."""
+
+    backend: str
+    knobs: dict
+    path: str | None = None
+    git_sha: str | None = None
+    created_wall: float | None = None
+    measured: dict | None = None
+    source: str = "explicit"
+
+    def knob(self, name: str, default: Any = None) -> Any:
+        return self.knobs.get(name, default)
+
+
+def default_backend() -> str:
+    """Best stdlib-only guess at the backend this process computes on:
+    a live jax module wins, then JAX_PLATFORMS, then "cpu".  Deliberately
+    never IMPORTS jax — the bench parent and the lint tiers resolve
+    profiles without bringing a runtime up."""
+    mod = sys.modules.get("jax")
+    if mod is not None:
+        try:
+            return str(mod.default_backend())
+        except Exception:  # pragma: no cover - partially initialised jax
+            pass
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if plats:
+        first = plats.split(",")[0].strip()
+        if first:
+            return first
+    return "cpu"
+
+
+def profile_path(backend: str, root: str | pathlib.Path | None = None) -> str:
+    """Committed location of ``backend``'s tuned profile artifact."""
+    base = pathlib.Path(root) if root is not None else _REPO_ROOT
+    return str(base / f"tuned_profile_{backend}.json")
+
+
+def load_tuned_profile(
+    backend: str | None = None,
+    path: str | pathlib.Path | None = None,
+    *,
+    root: str | pathlib.Path | None = None,
+) -> TunedProfile | None:
+    """Resolve and load the tuned profile for ``backend``.
+
+    Resolution ladder (highest wins):
+
+    1. an explicit ``path`` argument (CLI ``--tuned-profile``);
+    2. the ``GRAFT_TUNED_PROFILE`` env knob — ``"off"``/empty disables
+       profile loading entirely (returns None: every knob falls back to
+       ``TUNABLE_DEFAULTS``);
+    3. the committed ``tuned_profile_<backend>.json`` at the repo root
+       (None when absent — a missing committed profile is not an error).
+
+    A profile stamped for a DIFFERENT backend raises ``ProvenanceError``
+    (same guard class as the measured cost artifacts): a CPU-tuned
+    optimum must never silently steer a TPU run, nor vice versa.
+    """
+    from .artifacts import ProvenanceError
+
+    if backend is None:
+        backend = default_backend()
+    source = "explicit"
+    if path is None:
+        env = os.environ.get("GRAFT_TUNED_PROFILE")
+        if env is not None:
+            if env.strip().lower() in ("", "off", "0", "none"):
+                return None
+            path, source = env, "env"
+        else:
+            path, source = profile_path(backend, root=root), "committed"
+            if not os.path.exists(path):
+                return None
+    try:
+        text = pathlib.Path(path).read_text()
+    except OSError as exc:
+        raise TunedProfileError(
+            f"tuned profile {path} unreadable: {exc}"
+        ) from exc
+    try:
+        record = json.loads(text)
+    except json.JSONDecodeError as exc:
+        raise TunedProfileError(
+            f"tuned profile {path} is not valid JSON: {exc}"
+        ) from exc
+    if not isinstance(record, dict) or "backend" not in record \
+            or "knobs" not in record:
+        raise TunedProfileError(
+            f"tuned profile {path} missing required keys "
+            "('backend', 'knobs')"
+        )
+    stamped = str(record["backend"])
+    if stamped != backend:
+        raise ProvenanceError(
+            f"tuned profile {path} was measured on backend {stamped!r} but "
+            f"this run computes on {backend!r}; refusing to load a "
+            "cross-backend optimum (re-tune with tools/autotune.py on this "
+            "backend, or point GRAFT_TUNED_PROFILE at the right artifact)"
+        )
+    knobs = record["knobs"]
+    if not isinstance(knobs, dict) or not all(
+        isinstance(v, (int, float)) and not isinstance(v, bool)
+        for v in knobs.values()
+    ):
+        raise TunedProfileError(
+            f"tuned profile {path} knobs must map names to numbers"
+        )
+    return TunedProfile(
+        backend=stamped,
+        knobs=dict(knobs),
+        path=str(path),
+        git_sha=record.get("git_sha"),
+        created_wall=record.get("created_wall"),
+        measured=record.get("measured"),
+        source=source,
+    )
+
+
+def write_tuned_profile(
+    path: str | pathlib.Path,
+    backend: str,
+    knobs: dict,
+    *,
+    measured: dict | None = None,
+    force: bool = False,
+) -> dict:
+    """Commit a tuned profile artifact durably.
+
+    Same write discipline as the cost artifacts: backend-stamped,
+    ``check_overwrite`` guarded (a non-TPU run may not clobber a
+    TPU-stamped profile without ``force``), staged to a temp file and
+    published with ``durable_replace`` so a crash at any point leaves
+    either the old profile or the new one — never a torn JSON."""
+    from .artifacts import check_overwrite
+    from .checkpoint import durable_replace
+
+    check_overwrite(path, backend, force=force)
+    record = {
+        "backend": backend,
+        "knobs": {str(k): knobs[k] for k in sorted(knobs)},
+        "git_sha": _git_short_sha(),
+        "created_wall": time.time(),
+        "measured": dict(measured or {}),
+    }
+    target = pathlib.Path(path)
+    fd, tmp = tempfile.mkstemp(dir=str(target.parent) or ".",
+                               suffix=".tuned.tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(json.dumps(record, sort_keys=True) + "\n")
+        durable_replace(tmp, str(target))
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return record
+
+
+def tuned_config(cls: type, profile: TunedProfile | None = None,
+                 **overrides: Any) -> Any:
+    """Build config dataclass ``cls`` through the knob resolution ladder:
+    explicit non-None override > tuned-profile knob > field default (which
+    reads ``TUNABLE_DEFAULTS``).  ``None`` overrides mean "not specified"
+    — exactly what argparse hands over for an unset flag — so CLI layers
+    pass their whole namespace through without pre-filtering."""
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(overrides) - set(fields)
+    if unknown:
+        raise TypeError(
+            f"{cls.__name__} has no fields {sorted(unknown)}"
+        )
+    kwargs: dict = {}
+    for name, field in fields.items():
+        if overrides.get(name) is not None:
+            kwargs[name] = overrides[name]
+        elif profile is not None and name in TUNABLE_DEFAULTS \
+                and name in profile.knobs:
+            value = profile.knobs[name]
+            # int knobs arrive as JSON numbers; preserve the field's kind
+            if isinstance(TUNABLE_DEFAULTS.get(name), int):
+                value = int(value)
+            kwargs[name] = value
+    return cls(**kwargs)
+
+
+def _git_short_sha() -> str | None:
+    """Short HEAD sha of the repo the profile was tuned in (None when git
+    is unavailable — e.g. a deployed artifact tree)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=str(_REPO_ROOT), capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None

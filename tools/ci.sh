@@ -45,6 +45,57 @@ if [ "$dt" -gt "${GRAFT_COST_BUDGET_S:-10}" ]; then
     exit 1
 fi
 
+echo "== autotune smoke (dry-run prune plan + committed-profile round-trip, budget ${GRAFT_TUNE_BUDGET_S:-60}s) =="
+# The cost model that tier 3 audits with also DRIVES the tuner (ISSUE
+# 16): the dry-run must show static pruning discarding >=30% of the raw
+# knob grid before anything is measured, every group must keep at least
+# one survivor (a group pruned to zero would make the real sweep
+# unrunnable), and the committed per-backend profile must parse AND
+# round-trip through the same utils/config loader the runners resolve
+# knobs from — all inside the tuner's own declared budget knob.
+t0=$(date +%s)
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python tools/autotune.py --dry-run --json > /tmp/_autotune_plan.json
+python - /tmp/_autotune_plan.json <<'EOF'
+import json
+import os
+import sys
+import tempfile
+
+with open(sys.argv[1]) as f:
+    plan = json.load(f)["plan"]
+frac = plan["prune_frac"]
+assert frac >= 0.30, (
+    f"static pruning discarded only {frac:.1%} of the raw grid — the "
+    "cost model stopped doing the tuner's first-pass work")
+assert plan["raw_points"] == plan["pruned_points"] + plan["survivor_points"]
+for g, gp in plan["groups"].items():
+    assert gp["survivors"], f"group {g!r} pruned to zero survivors"
+
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import config
+
+prof = config.load_tuned_profile(backend="cpu")
+assert prof is not None, "committed tuned_profile_cpu.json did not load"
+assert prof.backend == "cpu" and prof.source == "committed"
+assert set(prof.knobs) == set(config.TUNABLE_DEFAULTS), (
+    sorted(set(config.TUNABLE_DEFAULTS) ^ set(prof.knobs)))
+with tempfile.TemporaryDirectory() as d:
+    p = os.path.join(d, "tuned_profile_cpu.json")
+    config.write_tuned_profile(p, "cpu", prof.knobs, measured={"smoke": True})
+    back = config.load_tuned_profile(path=p)
+    assert back.knobs == prof.knobs, "loader round-trip changed the knobs"
+print(f"autotune smoke: OK ({plan['pruned_points']}/{plan['raw_points']} "
+      f"points pruned statically = {frac:.1%}, committed cpu profile "
+      f"round-trips {len(prof.knobs)} knobs)")
+EOF
+rm -f /tmp/_autotune_plan.json
+dt=$(( $(date +%s) - t0 ))
+echo "autotune smoke: ${dt}s"
+if [ "$dt" -gt "${GRAFT_TUNE_BUDGET_S:-60}" ]; then
+    echo "FAIL: autotune smoke exceeded its ${GRAFT_TUNE_BUDGET_S:-60}s budget (${dt}s)" >&2
+    exit 1
+fi
+
 echo "== graftlint tier 4 (concurrency, budget ${GRAFT_CONC_BUDGET_S:-10}s; incl. lock-graph smoke) =="
 # Interprocedural concurrency & buffer-lifetime analysis (lock-order
 # cycles, blocking-under-lock, use-after-donate, chaos-coverage drift,
@@ -164,7 +215,11 @@ smoke_dir=$(mktemp -d)
 trap 'rm -rf "$smoke_dir"' EXIT
 printf 'alpha beta gamma\nbeta gamma delta\nepsilon zeta alpha\ngamma gamma beta\nalpha delta epsilon\nzeta zeta beta\n' \
     > "$smoke_dir/corpus.txt"
+# GRAFT_TUNED_PROFILE=off: the committed profile's pack_target_tokens
+# would re-pack this 6-doc corpus into one chunk; this smoke pins the
+# 3-chunk timeline, so it runs on dataclass defaults.
 if ! env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu GRAFT_TRACE_DIR="$smoke_dir" \
+    GRAFT_TUNED_PROFILE=off \
     python -m page_rank_and_tfidf_using_apache_spark_tpu.cli.tfidf \
         "$smoke_dir/corpus.txt" --lines --streaming --chunk-docs 2 \
         --vocab-bits 8 --prefetch 0 > "$smoke_dir/cli.log" 2>&1; then

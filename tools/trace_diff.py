@@ -34,6 +34,12 @@ fails the diff.  Rounds BEFORE the gauge existed carry no map, so the
 old-round fallback skips cleanly; a new round losing the map while the
 old one had it is flagged like the other gates.
 
+Since ISSUE 16 the new round's **tuned-profile provenance** is checked
+on its own (``extra.tuned_profile.backend`` vs ``extra.backend``): a
+round whose knobs came from a profile stamped for a different backend
+than the one it measured on fails the diff — its numbers were shaped by
+the wrong machine's sweep.  Rounds without a profile stamp skip cleanly.
+
 Stdlib-only (importable from the jax-free bench parent, same rule as
 trace_report.py).
 
@@ -258,6 +264,58 @@ def diff_comm(
     return rows
 
 
+def load_tuned_stamp(path: str) -> dict | None:
+    """Tuned-profile provenance riding a BENCH round: the backend the
+    committed profile was stamped with (``extra.tuned_profile.backend``,
+    since ISSUE 16) next to the backend the round actually measured on
+    (``extra.backend``).  None when the round predates autotuning, ran
+    without a profile, or the snapshot recorded a read error — absence is
+    attribution, only a present-and-wrong stamp is a finding."""
+    if path.endswith(".jsonl"):
+        return None
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    extra = record.get("extra", {})
+    prof = extra.get("tuned_profile")
+    if not isinstance(prof, dict) or prof.get("backend") is None:
+        return None
+    return {
+        "profile_backend": prof.get("backend"),
+        "measured_backend": extra.get("backend"),
+        "path": prof.get("path"),
+    }
+
+
+def check_tuned_backend(stamp: dict | None) -> list[dict]:
+    """Provenance gate on the NEW round alone (no old-round comparison):
+    a round steered by a tuned profile stamped for a DIFFERENT backend
+    than the one it measured on is reporting numbers shaped by the wrong
+    machine's sweep — the runtime loader refuses that combination
+    (``ProvenanceError``), so a mismatched stamp in a finished record
+    means the run resolved its knobs before the backend fell back (e.g.
+    a TPU-tuned profile applied after the CPU fallback kicked in)."""
+    if stamp is None:
+        return []
+    prof_b = stamp["profile_backend"]
+    meas_b = stamp["measured_backend"]
+    if meas_b in (None, "unknown") or prof_b == meas_b:
+        return []
+    return [{
+        "key": "tuned_profile.backend_mismatch",
+        "old": prof_b,
+        "new": meas_b,
+        "why": (f"round measured on {meas_b!r} but its knobs came from a "
+                f"profile tuned on {prof_b!r} "
+                f"({stamp['path'] or 'unknown path'}) — re-run the sweep "
+                "on the backend that serves"),
+    }]
+
+
 def diff_slo(
     old: dict | None, new: dict | None, threshold: float
 ) -> list[dict]:
@@ -373,11 +431,13 @@ def main(argv: list[str] | None = None) -> int:
                               load_served_p99(args.new), args.threshold)
     comm_rows = diff_comm(load_comm_bytes(args.old),
                           load_comm_bytes(args.new), args.threshold)
+    tuned_rows = check_tuned_backend(load_tuned_stamp(args.new))
     all_regressions = (
         [r["phase"] for r in regressions]
         + [r["key"] for r in slo_rows]
         + [r["key"] for r in served_rows]
         + [r["key"] for r in comm_rows]
+        + [r["key"] for r in tuned_rows]
     )
     result = {
         "old": {"path": args.old, "kind": old_kind, "wall_secs": old_wall},
@@ -386,6 +446,7 @@ def main(argv: list[str] | None = None) -> int:
         "slo": slo_rows,
         "served": served_rows,
         "comm": comm_rows,
+        "tuned_profile": tuned_rows,
         "regressions": all_regressions,
         "worst_regression": all_regressions[0] if all_regressions else None,
     }
@@ -405,7 +466,7 @@ def main(argv: list[str] | None = None) -> int:
             mark = " <-- REGRESSED" if r["phase"] in result["regressions"] else ""
             print(f"{r['phase']:28s} {r['old_secs']:9.3f} {r['new_secs']:9.3f} "
                   f"{r['delta_secs']:+9.3f}  {rel}{mark}")
-        for r in slo_rows + served_rows + comm_rows:
+        for r in slo_rows + served_rows + comm_rows + tuned_rows:
             print(f"{r['key']:28s} {r['old']!s:>9s} {r['new']!s:>9s}  "
                   f"{r['why']} <-- REGRESSED")
         if all_regressions:
