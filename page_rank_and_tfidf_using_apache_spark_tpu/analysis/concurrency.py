@@ -139,7 +139,8 @@ _HOST_READ_CALLS = frozenset({
 _HOST_READ_METHODS = frozenset({"block_until_ready", "item", "tolist"})
 
 _CHAOS_TOKEN_RE = re.compile(
-    r"([A-Za-z_][A-Za-z0-9_]*):(?:fail|lost|hang|device_lost)@"
+    r"([A-Za-z_][A-Za-z0-9_]*)"
+    r":(?:fail|lost|hang|device_lost|proc_kill|net_partition|net_hang)@"
 )
 
 

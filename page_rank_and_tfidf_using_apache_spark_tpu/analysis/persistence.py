@@ -141,6 +141,7 @@ CRASH_ENTRIES: tuple[str, ...] = (
     f"{_PKG}/serving/segments.py::merge_segments",
     f"{_PKG}/serving/artifact.py::save_index",
     f"{_PKG}/utils/checkpoint.py::save_checkpoint",
+    f"{_PKG}/serving/fabric.py::commit_floor",
 )
 
 

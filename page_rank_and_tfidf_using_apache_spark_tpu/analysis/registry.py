@@ -230,6 +230,14 @@ ARTIFACT_SCHEMAS: tuple = (
      (f"{_PKG}/utils/config.py::load_tuned_profile::record",),
      ("backend", "knobs", "git_sha", "created_wall", "measured"),
      ()),
+    # the serving fleet's committed generation floor (ISSUE 17): one JSON
+    # doc next to the segment manifest, staged + durably replaced like
+    # every other commit; committed_wall is rollout forensics only
+    ("fabric_floor",
+     (f"{_PKG}/serving/fabric.py::commit_floor",),
+     (f"{_PKG}/serving/fabric.py::read_floor",),
+     ("floor", "committed_wall"),
+     ("committed_wall",)),
 )
 
 # ``COMMIT_LOCKS`` declares which lock serializes each on-disk protocol's

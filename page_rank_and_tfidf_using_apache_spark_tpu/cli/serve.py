@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import signal
 import sys
 
 from page_rank_and_tfidf_using_apache_spark_tpu import obs
 from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
     ServeConfig,
+    ServerShutdown,
     TfidfServer,
     load_index,
 )
@@ -133,6 +135,21 @@ def _main(args) -> int:
     exporter = obs.export.serve_metrics_from_env()
     source = sys.stdin if args.queries == "-" else open(args.queries)
     lat: list[float] = []
+    shutdown = False
+
+    # Graceful SIGTERM (the rolling-restart building block): raising from
+    # the handler aborts whatever blocking read/wait the main thread is in
+    # (PEP 475 does not retry when the handler raises), we stop accepting,
+    # drain every already-accepted request, and the server's stop() fails
+    # anything left with the typed ServerShutdown — a supervisor's TERM
+    # never hangs a piped client.
+    def _on_sigterm(signum, frame):
+        raise ServerShutdown("SIGTERM")
+
+    try:
+        prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        prev_sigterm = None  # not the main thread (tests drive _main directly)
     try:
         # stdin is request/response: a client writing one query and
         # waiting for output must get its answer before this process
@@ -142,42 +159,54 @@ def _main(args) -> int:
         interactive = source is sys.stdin
         with TfidfServer(index, cfg) as srv:
             pending = []
-            for qid, line in enumerate(source):
-                terms = line.split()
-                if not terms:
-                    continue
-                ranker = args.ranker
-                if terms[0] in ("@tfidf", "@bm25", "@prior"):  # per-request A/B
-                    ranker = terms[0][1:]
-                    terms = terms[1:]
+            try:
+                for qid, line in enumerate(source):
+                    terms = line.split()
                     if not terms:
                         continue
-                try:
-                    pending.append((qid, srv.submit(terms, ranker=ranker)))
-                except ValueError as exc:
-                    # one bad line (e.g. '@bm25' against an index without
-                    # BM25 weights) must not kill the serve session —
-                    # report it and keep draining the stream
-                    print(f"query {qid}: {exc}", file=sys.stderr)
-                    continue
-                if interactive:
-                    while pending:
-                        _drain_one(pending, lat)
-                else:
-                    # drain in submit order: eagerly when already
-                    # resolved, blocking only to bound the window
-                    while pending and pending[0][1].done:
-                        _drain_one(pending, lat)
-                    while len(pending) > cfg.max_batch:
-                        _drain_one(pending, lat)
+                    ranker = args.ranker
+                    if terms[0] in ("@tfidf", "@bm25", "@prior"):  # per-request A/B
+                        ranker = terms[0][1:]
+                        terms = terms[1:]
+                        if not terms:
+                            continue
+                    try:
+                        pending.append((qid, srv.submit(terms, ranker=ranker)))
+                    except ValueError as exc:
+                        # one bad line (e.g. '@bm25' against an index without
+                        # BM25 weights) must not kill the serve session —
+                        # report it and keep draining the stream
+                        print(f"query {qid}: {exc}", file=sys.stderr)
+                        continue
+                    if interactive:
+                        while pending:
+                            _drain_one(pending, lat)
+                    else:
+                        # drain in submit order: eagerly when already
+                        # resolved, blocking only to bound the window
+                        while pending and pending[0][1].done:
+                            _drain_one(pending, lat)
+                        while len(pending) > cfg.max_batch:
+                            _drain_one(pending, lat)
+            except ServerShutdown:
+                shutdown = True
+                obs.emit("serve_sigterm", pending=len(pending))
+            # accepted requests drain to completion even on SIGTERM; any
+            # future the stopping server failed surfaces typed, not hung
             while pending:
-                _drain_one(pending, lat)
+                try:
+                    _drain_one(pending, lat)
+                except ServerShutdown as exc:
+                    print(f"shutdown: request failed: {exc}", file=sys.stderr)
             stats = srv.stats()
     finally:
+        if prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, prev_sigterm)
         if source is not sys.stdin:
             source.close()
         if exporter is not None:
             exporter.stop()
+    stats["shutdown"] = "sigterm" if shutdown else None
     stats["p50_ms"], stats["p99_ms"] = _percentiles_ms(lat)
     print(json.dumps(stats), file=sys.stderr)
     return 0

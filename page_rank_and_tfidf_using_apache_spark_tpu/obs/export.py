@@ -45,7 +45,21 @@ from page_rank_and_tfidf_using_apache_spark_tpu.obs.metrics import (
 )
 
 
-def _make_handler(hub: MetricsHub):
+def _make_handler(hub: MetricsHub, *, routes=None, ready=None):
+    """The handler class behind :class:`MetricsExporter`.
+
+    ``routes`` extends the surface without forking the endpoint: a dict
+    mapping ``(method, path)`` (e.g. ``("POST", "/query")``) to a
+    callable ``body_bytes -> (status, content_type, body_str)`` — the
+    serving-fabric replica rides its query/status API on the same server
+    (and the same ``graft-metrics-http`` thread) as its health checks.
+    ``ready`` is an optional zero-arg readiness predicate: when it
+    returns False, ``/healthz`` answers 503 — a replica that is still
+    warming, or is held below the fleet's committed generation floor,
+    reports itself unroutable through the SAME endpoint the router
+    health-checks."""
+    routes = routes or {}
+
     class Handler(BaseHTTPRequestHandler):
         server_version = "graft-metrics/1"
 
@@ -60,17 +74,25 @@ def _make_handler(hub: MetricsHub):
             self.end_headers()
             self.wfile.write(data)
 
-        def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        def _dispatch(self, method: str, body: bytes) -> None:
             path = self.path.split("?", 1)[0]
             try:
-                if path in ("/snapshot.json", "/snapshot", "/json"):
+                fn = routes.get((method, path))
+                if fn is not None:
+                    code, ctype, payload = fn(body)
+                    self._send(code, payload, ctype)
+                elif method == "GET" and path in ("/snapshot.json",
+                                                  "/snapshot", "/json"):
                     self._send(200, json.dumps(hub.snapshot(), default=float),
                                "application/json")
-                elif path == "/metrics":
+                elif method == "GET" and path == "/metrics":
                     self._send(200, hub.prometheus(),
                                "text/plain; version=0.0.4")
-                elif path in ("/", "/healthz"):
-                    self._send(200, "ok\n", "text/plain")
+                elif method == "GET" and path in ("/", "/healthz"):
+                    if ready is not None and not ready():
+                        self._send(503, "unready\n", "text/plain")
+                    else:
+                        self._send(200, "ok\n", "text/plain")
                 else:
                     self._send(404, "not found\n", "text/plain")
             except Exception as exc:  # noqa: BLE001 — never kill the server
@@ -79,6 +101,13 @@ def _make_handler(hub: MetricsHub):
                                "text/plain")
                 except Exception:  # noqa: BLE001 — client already gone
                     pass
+
+        def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+            self._dispatch("GET", b"")
+
+        def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+            n = int(self.headers.get("Content-Length") or 0)
+            self._dispatch("POST", self.rfile.read(n) if n else b"")
 
     return Handler
 
@@ -93,10 +122,12 @@ class MetricsExporter:
     audit surface is the hub, not the exporter)."""
 
     def __init__(self, hub: MetricsHub, *, port: int = 0,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", routes=None, ready=None):
         self.hub = hub
         self.host = host
         self.port = int(port)
+        self.routes = routes
+        self.ready = ready
         self._srv: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -104,7 +135,8 @@ class MetricsExporter:
         if self._srv is not None:
             return self
         self._srv = ThreadingHTTPServer(
-            (self.host, self.port), _make_handler(self.hub)
+            (self.host, self.port),
+            _make_handler(self.hub, routes=self.routes, ready=self.ready),
         )
         self._srv.daemon_threads = True
         self.port = int(self._srv.server_address[1])

@@ -8,6 +8,7 @@ and recovery" for the contract."""
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience.chaos import (
     ChaosError,
     DeviceLostError,
+    PartitionError,
     inject,
     parse_plan,
 )
@@ -31,6 +32,7 @@ __all__ = [
     "ChaosError",
     "DeviceHealth",
     "DeviceLostError",
+    "PartitionError",
     "ResilienceExhausted",
     "RetryPolicy",
     "ShrinkPlan",

@@ -31,12 +31,27 @@ Plan specification — the ``GRAFT_CHAOS`` env var or :func:`inject`::
                    how a real dead chip behaves: every touch fails until
                    the scheduler stops scheduling onto it.  Spelled
                    ``device_lost@dev:K`` (K = index into jax.devices()).
+           proc_kill - SIGKILL the *current process* at the site (the
+                   chaos event is flushed to the trace first): a replica
+                   dying mid-query or mid-hot-swap in the serving fabric.
+                   Recovery belongs to a DIFFERENT process (the fabric
+                   supervisor respawns; the router re-dispatches), so this
+                   kind never returns.
+           net_partition - raise PartitionError (a ChaosError subclass,
+                   so still *transient* to the executor): the router's
+                   view of an unreachable replica.  The fabric marks the
+                   target suspect and retries the query on a sibling.
+           net_hang - sleep <param> MILLISECONDS (default 500) before
+                   returning — a slow/blackholed network hop, deliberately
+                   in ms where ``hang`` is in seconds: network stalls are
+                   bounded by request timeouts, not the sync watchdog.
     when   N     the Nth guarded call at this site (1-based), exactly once
            N+    every call from the Nth on
            %K    every Kth call (K, 2K, 3K, ...)
            dev   (device_lost only) every call while device <param> is
                  still considered healthy
-    param  seconds for hang; the logical device index for device_lost
+    param  seconds for hang; MILLISECONDS for net_hang; the logical
+           device index for device_lost
 
 Examples::
 
@@ -58,6 +73,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import re
+import signal
 import threading
 import time
 
@@ -67,6 +83,14 @@ from page_rank_and_tfidf_using_apache_spark_tpu import obs
 class ChaosError(RuntimeError):
     """Injected *transient* device error (stands in for the retryable
     XlaRuntimeError family: UNAVAILABLE / DEADLINE_EXCEEDED / ...)."""
+
+
+class PartitionError(ChaosError):
+    """Injected network partition between router and replica (kind
+    ``net_partition``).  A :class:`ChaosError` subclass on purpose: to the
+    retry machinery a partition is transient (the link may heal), but the
+    fabric router additionally marks the target replica *suspect* so the
+    very next attempt routes to a sibling instead of the black hole."""
 
 
 class DeviceLostError(RuntimeError):
@@ -85,9 +109,9 @@ class DeviceLostError(RuntimeError):
 @dataclasses.dataclass(frozen=True)
 class Injection:
     site: str  # exact site name or "*"
-    kind: str  # "fail" | "lost" | "hang"
-    when: str  # "N" | "N+" | "%K"
-    param: float  # seconds, for hang
+    kind: str  # "fail" | "lost" | "hang" | "device_lost" | "proc_kill" | "net_partition" | "net_hang"
+    when: str  # "N" | "N+" | "%K" | "dev"
+    param: float  # seconds for hang, ms for net_hang, device for device_lost
 
     def matches(self, site: str, count: int) -> bool:
         if self.site != "*" and self.site != site:
@@ -119,7 +143,8 @@ def parse_plan(spec: str) -> tuple[Injection, ...]:
         if "@" not in action:
             raise ValueError(f"bad chaos injection {raw!r}: missing @when")
         kind, when = action.split("@", 1)
-        if kind not in ("fail", "lost", "hang", "device_lost"):
+        if kind not in ("fail", "lost", "hang", "device_lost",
+                        "proc_kill", "net_partition", "net_hang"):
             raise ValueError(f"bad chaos kind {kind!r} in {raw!r}")
         if kind == "device_lost":
             # grammar: site:device_lost@dev:K — the device index rides in
@@ -135,7 +160,12 @@ def parse_plan(spec: str) -> tuple[Injection, ...]:
         m = re.fullmatch(r"%(\d+)|(\d+)\+?", when)
         if m is None or int(m.group(1) or m.group(2)) < 1:
             raise ValueError(f"bad chaos schedule {when!r} in {raw!r}")
-        param = float(parts[2]) if len(parts) == 3 else 3600.0
+        if len(parts) == 3:
+            param = float(parts[2])
+        else:
+            # hang defaults to "forever" (only a deadline interrupts it);
+            # net_hang to 500 ms (a stall a request timeout should absorb)
+            param = 500.0 if kind == "net_hang" else 3600.0
         out.append(Injection(site=site, kind=kind, when=when, param=param))
     return tuple(out)
 
@@ -189,6 +219,18 @@ class ChaosPlan:
             if inj.kind == "hang":
                 time.sleep(inj.param)
                 return
+            if inj.kind == "net_hang":
+                time.sleep(inj.param / 1000.0)
+                return
+            if inj.kind == "proc_kill":
+                os.kill(os.getpid(), signal.SIGKILL)
+                # unreachable in a real run; during tests os.kill may be
+                # monkeypatched to observe the schedule without dying
+                return
+            if inj.kind == "net_partition":
+                raise PartitionError(
+                    f"chaos: partition at {site} call #{count}"
+                )
             if inj.kind == "lost":
                 raise DeviceLostError(
                     f"chaos: device lost at {site} call #{count}"

@@ -35,6 +35,7 @@ from page_rank_and_tfidf_using_apache_spark_tpu.serving.segments import (
 from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
     RANKERS,
     ServeConfig,
+    ServerShutdown,
     TfidfServer,
     batch_cap,
     impacted_pad_plan,
@@ -43,17 +44,22 @@ from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
 
 __all__ = [
     "RANKERS",
+    "FabricConfig",
     "SegmentMerger",
     "SegmentSet",
     "ServableIndex",
     "ServeConfig",
+    "ServerShutdown",
+    "ServingFabric",
     "SoakConfig",
     "TfidfServer",
     "batch_cap",
     "commit_append",
+    "commit_floor",
     "impacted_pad_plan",
     "load_index",
     "load_segment_set",
+    "read_floor",
     "run_soak",
     "save_index",
     "seal_segment",
@@ -63,9 +69,15 @@ __all__ = [
 
 def __getattr__(name: str):
     # serving.soak pulls in models/ and io/ (the ingest + PageRank side);
-    # lazy so plain serving users don't pay its import chain.
+    # serving.fabric pulls in subprocess/HTTP plumbing — both lazy so
+    # plain serving users don't pay their import chains.
     if name in ("SoakConfig", "run_soak"):
         from page_rank_and_tfidf_using_apache_spark_tpu.serving import soak
 
         return getattr(soak, name)
+    if name in ("FabricConfig", "ServingFabric", "commit_floor",
+                "read_floor"):
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import fabric
+
+        return getattr(fabric, name)
     raise AttributeError(name)

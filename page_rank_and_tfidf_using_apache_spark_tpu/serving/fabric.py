@@ -1,0 +1,885 @@
+"""Multi-process serving fabric (ISSUE 17): a replica fleet behind a
+consistent-hash router, scaled out past one process.
+
+Everything before this module survives faults inside ONE process; Spark's
+actual resilience story is a driver coordinating executor *processes*
+that die and get replaced (PAPER.md's driver/executor correspondence).
+Here the immutable segment artifacts + atomic LATEST pointer already make
+cross-process index sharing free — N replica processes mmap the SAME
+segment files — so this module adds only the coordination:
+
+- **Replica** (``python -m ...serving.fabric --replica INDEX_DIR``): one
+  :class:`~.server.TfidfServer` process that mmap-loads the live segment
+  set, serves ``POST /query`` over the obs/export HTTP endpoint (same
+  server, same ``/healthz`` the router health-checks), polls the manifest
+  and hot-swaps independently, and keeps an idempotent request-id cache
+  so a re-dispatched query is *replayed*, never re-executed.
+- **Generation floor** (:func:`commit_floor` / :func:`read_floor`): the
+  fleet's committed generation, durably written next to the manifest.
+  ENFORCED, not advisory: a replica whose loaded generation is below the
+  floor reports ``/healthz`` 503 and refuses queries — a replica
+  restarted mid-rolling-swap cannot quietly serve a pre-floor artifact
+  (the tier-5 kill-point harness covers the floor-commit write boundary).
+- **Router** (:class:`ServingFabric`): consistent-hash query routing
+  (``ring_slots`` vnodes per replica, so the per-replica LRU becomes a
+  sharded distributed cache and at most ~1/N of keys remap when a
+  replica leaves), health checking, and sibling retry of a failed
+  replica's in-flight queries under the SAME request id — the soak's
+  dropped=0 / double_served=0 audit extends across processes.
+- **Supervisor**: respawns dead replicas through the declared ``respawn``
+  ladder rung (:mod:`resilience.process`) and drives rolling restarts:
+  wait for the fleet to reach generation G, commit the floor at G, then
+  TERM→respawn one replica at a time while siblings keep serving.
+
+Process-level chaos rides the deterministic ``GRAFT_CHAOS`` grammar:
+``replica_query:proc_kill@N`` SIGKILLs a replica mid-query (injected in
+THAT replica's environment via ``FabricConfig.replica_chaos``),
+``replica_swap:proc_kill@1`` kills it mid-hot-swap, and
+``fabric_route:net_partition@N`` / ``fabric_route:net_hang@N:ms`` fault
+the router→replica hop.  All three sites are guarded through
+``resilience.executor.attempt_once`` — one chaos-hooked attempt each;
+the recovery loop (sibling retry, supervisor respawn) lives HERE, which
+is exactly what attempt_once is for.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import dataclasses
+import hashlib
+import itertools
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Sequence
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import (
+    executor as rx,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import (
+    process as procs,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.utils import checkpoint as ckpt
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import percentile
+
+# Guarded chaos/retry sites of the fabric (tools/chaos.sh + tests name
+# them; tier-4 chaos-coverage-drift audits that every site stays covered):
+# the router→replica hop, the replica's hot-swap, the replica's query
+# execution.
+ROUTE_SITE = "fabric_route"
+SWAP_SITE = "replica_swap"
+QUERY_SITE = "replica_query"
+
+# The fleet's committed generation, next to LATEST in the index dir.
+FLOOR_FILE = "FABRIC_FLOOR"
+
+
+class FabricExhausted(RuntimeError):
+    """A query ran out of sibling retries — every replica was dead,
+    partitioned, or below the generation floor for the whole retry
+    window.  The router-side analog of ResilienceExhausted."""
+
+
+# --------------------------------------------------------------- floor
+
+
+def commit_floor(index_dir: str, generation: int) -> None:
+    """Durably commit the fleet's generation floor: no replica may serve
+    a generation below this after the write lands.  Same atomic-write
+    discipline as every other artifact (stage in a same-dir tmp, fsync,
+    rename) — a SIGKILL at any boundary leaves the old floor or the new
+    floor, never a torn file (the tier-5 'floor' kill-point scenario
+    sweeps exactly this function)."""
+    doc = {"floor": int(generation), "committed_wall": time.time()}
+    path = os.path.join(index_dir, FLOOR_FILE)
+    fd, tmp = tempfile.mkstemp(dir=index_dir, suffix=".floor.tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        ckpt.durable_replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    obs.emit("fabric_floor", floor=int(generation))
+
+
+def read_floor(index_dir: str) -> int:
+    """The committed generation floor; 0 when none was ever committed
+    (every generation is servable)."""
+    try:
+        with open(os.path.join(index_dir, FLOOR_FILE)) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return 0
+    return int(doc.get("floor", 0))
+
+
+# --------------------------------------------------------------- config
+
+
+@dataclasses.dataclass(frozen=True)
+class FabricConfig:
+    """Fleet shape + routing/retry/respawn policy."""
+
+    replicas: int = 2
+    ring_slots: int = 64  # vnodes per replica on the hash ring
+    top_k: int = 10
+    max_batch: int | None = None  # None: replica resolves its own ladder
+    scoring: str = "coo"
+    poll_s: float = 0.3  # replica manifest/floor poll period
+    health_period_s: float = 0.5  # router health-check + stats-fold period
+    request_timeout_s: float = 10.0  # one router→replica HTTP attempt
+    retry_limit: int = 40  # sibling re-dispatch attempts per query
+    retry_pause_s: float = 0.25  # pause between re-dispatches (lets the
+    # supervisor respawn a dead replica inside the retry window)
+    ready_timeout_s: float = 120.0  # replica spawn→handshake deadline
+    grace_s: float = 15.0  # rolling restart: SIGTERM→SIGKILL deadline
+    respawn: bool = True  # supervisor replaces dead replicas
+    replica_chaos: tuple = ()  # ((replica_idx, GRAFT_CHAOS spec), ...):
+    # targeted replica-side injection — the spec lands in THAT replica's
+    # environment only, so a proc_kill schedule is per-process-deterministic
+
+    @staticmethod
+    def from_env(**overrides) -> "FabricConfig":
+        if "replicas" not in overrides:
+            raw = os.environ.get("GRAFT_FABRIC_REPLICAS")
+            if raw:
+                overrides["replicas"] = int(raw)
+        return FabricConfig(**overrides)
+
+
+# --------------------------------------------------------------- ring
+
+
+def _h(key: str) -> int:
+    return int.from_bytes(hashlib.sha1(key.encode()).digest()[:8], "big")
+
+
+class _Ring:
+    """Consistent-hash ring: ``slots`` vnodes per replica.  A replica
+    leaving removes only ITS vnodes — keys owned by survivors keep their
+    owner (the ≤1/N remap property the stability test pins)."""
+
+    def __init__(self, replica_ids: Sequence[int], slots: int):
+        points: list[tuple[int, int]] = []
+        for rid in replica_ids:
+            for s in range(slots):
+                points.append((_h(f"replica-{rid}#{s}"), rid))
+        points.sort()
+        self._points = points
+
+    def route(self, key: str, *, exclude: "set[int] | None" = None) -> list[int]:
+        """Replica preference order for ``key``: clockwise walk from the
+        key's ring position, first occurrence of each replica; excluded
+        (suspect) replicas move to the back rather than vanishing — with
+        every replica suspect the caller still gets a candidate."""
+        if not self._points:
+            return []
+        hv = _h(key)
+        lo, hi = 0, len(self._points)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._points[mid][0] < hv:
+                lo = mid + 1
+            else:
+                hi = mid
+        order: list[int] = []
+        for i in range(len(self._points)):
+            rid = self._points[(lo + i) % len(self._points)][1]
+            if rid not in order:
+                order.append(rid)
+        if exclude:
+            order = ([r for r in order if r not in exclude]
+                     + [r for r in order if r in exclude])
+        return order
+
+
+def affinity_key(terms: Sequence[str], ranker: str) -> str:
+    """The routing key: canonicalized like the server's result-cache key
+    (ranker + sorted unique terms), so the SAME logical query always
+    lands on the SAME replica and the per-replica LRU shards cleanly."""
+    return ranker + "|" + " ".join(sorted(set(terms)))
+
+
+# --------------------------------------------------------------- replica
+
+
+def _percentiles_ms(lat: "collections.deque[float]") -> tuple[Any, Any]:
+    if not lat:
+        return None, None
+    xs = sorted(lat)
+    return (round(percentile(xs, 0.50) * 1e3, 3),
+            round(percentile(xs, 0.99) * 1e3, 3))
+
+
+class _Replica:
+    """The replica-process runtime: one TfidfServer + the floor-enforcing
+    poll loop + the idempotent query surface."""
+
+    def __init__(self, index_dir: str, *, replica_id: int, top_k: int,
+                 max_batch: int | None, scoring: str, poll_s: float,
+                 rid_cache: int = 4096):
+        self.index_dir = index_dir
+        self.replica_id = replica_id
+        self.top_k = top_k
+        self.max_batch = max_batch
+        self.scoring = scoring
+        self.poll_s = poll_s
+        self.srv = None  # TfidfServer once a servable generation loaded
+        self.generation: int | None = None
+        self.floor = read_floor(index_dir)
+        # rid → cached response body: a re-dispatched request id replays
+        # the SAME bytes instead of re-executing (the cross-process
+        # double-serve guard); capped LRU
+        self._rid_cache: collections.OrderedDict[str, tuple] = (
+            collections.OrderedDict()
+        )
+        self._rid_cap = rid_cache
+        self._lock = threading.Lock()  # floor/generation/rid-cache/latencies
+        self._lat: collections.deque = collections.deque(maxlen=512)
+        self._executions = 0
+        self._replays = 0
+        self._stop = threading.Event()
+        self._poll_thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> "_Replica":
+        self._try_load()  # may come up unready (below floor / no manifest)
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="fabric-replica-poll", daemon=True
+        )
+        self._poll_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=10.0)
+            self._poll_thread = None
+        if self.srv is not None:
+            self.srv.stop()
+
+    def ready(self) -> bool:
+        with self._lock:
+            return (self.srv is not None and self.generation is not None
+                    and self.generation >= self.floor)
+
+    # ----------------------------------------------------------- load/swap
+
+    def _serve_config(self):
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+            ServeConfig,
+        )
+        from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+            load_tuned_profile,
+            tuned_config,
+        )
+
+        return tuned_config(ServeConfig, load_tuned_profile(),
+                            top_k=self.top_k, max_batch=self.max_batch,
+                            scoring=self.scoring)
+
+    def _try_load(self) -> None:
+        """Initial load — refused outright while the newest committed
+        manifest is below the floor: a replica restarted mid-rolling-swap
+        must NOT serve the pre-floor artifact it can still see on disk;
+        it stays unready and keeps polling until the fleet's generation
+        catches up."""
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+            segments as sgm,
+        )
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+            TfidfServer,
+        )
+
+        version = sgm.manifest_version(self.index_dir)
+        with self._lock:
+            floor = self.floor
+        if version is None or version < floor:
+            obs.emit("fabric_refuse", replica=self.replica_id,
+                     version=version, floor=floor)
+            return
+        segset = sgm.load_segment_set(self.index_dir, mmap=True)
+        srv = TfidfServer(segset, self._serve_config()).start()
+        with self._lock:
+            self.srv = srv
+            self.generation = segset.version
+
+    def _poll_loop(self) -> None:
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+            segments as sgm,
+        )
+
+        while not self._stop.wait(self.poll_s):
+            floor = read_floor(self.index_dir)
+            with self._lock:
+                if floor > self.floor:
+                    self.floor = floor
+                gen = self.generation
+            if self.srv is None:
+                try:
+                    self._try_load()
+                except Exception as exc:  # noqa: BLE001 — keep polling
+                    obs.emit("fabric_load_error", replica=self.replica_id,
+                             error=f"{type(exc).__name__}: {exc}"[:200])
+                continue
+            version = sgm.manifest_version(self.index_dir)
+            if version is None or gen is None or version <= gen:
+                continue
+            try:
+                # ONE chaos-hooked swap attempt (proc_kill here is the
+                # kill-during-hot-swap scenario); a failed swap keeps the
+                # old generation live and the next tick retries
+                segset = rx.attempt_once(
+                    lambda: sgm.load_segment_set(self.index_dir, mmap=True),
+                    site=SWAP_SITE,
+                )
+                self.srv.refresh_segments(segset)
+                with self._lock:
+                    self.generation = segset.version
+                obs.emit("fabric_swap", replica=self.replica_id,
+                         generation=segset.version, floor=floor)
+            except Exception as exc:  # noqa: BLE001 — swap again next tick
+                obs.emit("fabric_swap_error", replica=self.replica_id,
+                         error=f"{type(exc).__name__}: {exc}"[:200])
+
+    # ----------------------------------------------------------- HTTP API
+
+    def handle_query(self, body: bytes) -> tuple[int, str, str]:
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+            ServerShutdown,
+        )
+
+        try:
+            req = json.loads(body.decode("utf-8"))
+            rid = str(req["rid"])
+            terms = [str(t) for t in req["terms"]]
+            ranker = str(req.get("ranker", "tfidf"))
+        except (ValueError, KeyError, UnicodeDecodeError) as exc:
+            return (400, "application/json",
+                    json.dumps({"error": f"bad request: {exc}"}))
+        with self._lock:
+            cached = self._rid_cache.get(rid)
+            if cached is not None:
+                self._rid_cache.move_to_end(rid)
+                self._replays += 1
+        if cached is not None:
+            return cached  # idempotent replay: same bytes, no re-execution
+        if not self.ready():
+            with self._lock:
+                gen, floor = self.generation, self.floor
+            return (503, "application/json",
+                    json.dumps({"error": "replica below generation floor",
+                                "generation": gen, "floor": floor}))
+        t0 = time.perf_counter()
+        try:
+            # ONE chaos-hooked execution (proc_kill here is the
+            # replica-SIGKILL-mid-query scenario; the router's sibling
+            # retry owns recovery)
+            scores, docs = rx.attempt_once(
+                lambda: self.srv.query(terms, ranker=ranker),
+                site=QUERY_SITE,
+            )
+        except ServerShutdown as exc:
+            return (503, "application/json",
+                    json.dumps({"error": f"shutdown: {exc}"}))
+        except ValueError as exc:  # unknown ranker / no BM25 weights
+            return (400, "application/json", json.dumps({"error": str(exc)}))
+        with self._lock:
+            gen = self.generation
+        resp = (200, "application/json", json.dumps({
+            "rid": rid,
+            "replica": self.replica_id,
+            "generation": gen,
+            "scores": [float(s) for s in scores],
+            "docs": [int(d) for d in docs],
+        }))
+        with self._lock:
+            self._executions += 1
+            self._lat.append(time.perf_counter() - t0)
+            self._rid_cache[rid] = resp
+            while len(self._rid_cache) > self._rid_cap:
+                self._rid_cache.popitem(last=False)
+        return resp
+
+    def handle_status(self, body: bytes) -> tuple[int, str, str]:
+        with self._lock:
+            gen, floor = self.generation, self.floor
+            executions, replays = self._executions, self._replays
+            p50, p99 = _percentiles_ms(self._lat)
+        stats = dict(self.srv.stats()) if self.srv is not None else {}
+        return (200, "application/json", json.dumps({
+            "replica": self.replica_id,
+            "pid": os.getpid(),
+            "ready": self.ready(),
+            "generation": gen,
+            "floor": floor,
+            "executions": executions,
+            "replays": replays,
+            "p50_ms": p50,
+            "p99_ms": p99,
+            "requests": int(stats.get("requests", 0)),
+            "cache_hits": int(stats.get("cache_hits", 0)),
+            "refreshes": int(stats.get("refreshes", 0)),
+        }))
+
+
+def replica_main(argv: "list[str] | None" = None) -> int:
+    """``--replica`` process entry: serve one replica until SIGTERM.
+
+    Prints the one-line JSON ready handshake (port, pid, generation) on
+    stdout once the HTTP surface is up — possibly *unready* below the
+    floor; readiness is the router's business via /healthz.  Runs under
+    ``obs.run`` so the replica writes its own trace and adopts
+    ``GRAFT_TRACE_PARENT`` — the fleet stitches into one trace tree."""
+    p = argparse.ArgumentParser(prog="fabric-replica")
+    p.add_argument("index")
+    p.add_argument("--replica-id", type=int, default=0)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--top-k", type=int, default=10)
+    p.add_argument("--max-batch", type=int, default=None)
+    p.add_argument("--scoring", choices=["coo", "impacted"], default="coo")
+    p.add_argument("--poll-s", type=float, default=0.3)
+    args = p.parse_args(argv)
+
+    stop = threading.Event()
+
+    def _on_sigterm(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+
+    with obs.run(f"fabric-replica{args.replica_id}"):
+        rep = _Replica(args.index, replica_id=args.replica_id,
+                       top_k=args.top_k, max_batch=args.max_batch,
+                       scoring=args.scoring, poll_s=args.poll_s).start()
+        exporter = obs.export.MetricsExporter(
+            obs.export.default_hub(), port=args.port,
+            routes={("POST", "/query"): rep.handle_query,
+                    ("GET", "/status"): rep.handle_status},
+            ready=rep.ready,
+        ).start()
+        print(json.dumps({"ready": True, "port": exporter.port,
+                          "pid": os.getpid(),
+                          "generation": rep.generation}), flush=True)
+        try:
+            stop.wait()
+        finally:
+            # graceful: stop accepting (HTTP down), then drain the server
+            # — still-pending futures fail typed (ServerShutdown), and
+            # the router re-dispatches them on a sibling
+            exporter.stop()
+            rep.stop()
+    return 0
+
+
+# --------------------------------------------------------------- router
+
+
+class ServingFabric:
+    """Router + supervisor over N replica processes (see module doc)."""
+
+    def __init__(self, index_dir: str, cfg: FabricConfig = FabricConfig()):
+        self.index_dir = index_dir
+        self.cfg = cfg
+        self._handles: list[procs.ProcessHandle] = []
+        self._ports: list[int] = []
+        self._suspect: set[int] = set()
+        self._restarting: set[int] = set()
+        self._down_since: dict[int, float] = {}
+        self._ring = _Ring(range(cfg.replicas), cfg.ring_slots)
+        self._lock = threading.Lock()  # ports/suspects/audit/stats
+        self._stats: collections.Counter = collections.Counter()
+        self._audit: dict[str, int] = {}  # rid -> accepted deliveries
+        self._rid_seq = itertools.count()
+        self._rid_prefix = f"f{os.getpid()}-{int(time.time() * 1e3) & 0xFFFFFF}"
+        self._stop = threading.Event()
+        self._health_thread: threading.Thread | None = None
+        self._sup_thread: threading.Thread | None = None
+        self._started = False
+
+    # ----------------------------------------------------------- lifecycle
+
+    def _replica_argv(self, i: int) -> list[str]:
+        argv = [sys.executable, "-m",
+                "page_rank_and_tfidf_using_apache_spark_tpu.serving.fabric",
+                "--replica", self.index_dir,
+                "--replica-id", str(i),
+                "--port", "0",
+                "--top-k", str(self.cfg.top_k),
+                "--scoring", self.cfg.scoring,
+                "--poll-s", str(self.cfg.poll_s)]
+        if self.cfg.max_batch is not None:
+            argv += ["--max-batch", str(self.cfg.max_batch)]
+        return argv
+
+    def _replica_env(self, i: int) -> dict[str, str]:
+        env = procs.fabric_pgid_env()  # parent chaos plan never leaks in
+        for idx, spec in self.cfg.replica_chaos:
+            if idx == i:
+                env["GRAFT_CHAOS"] = spec
+        return env
+
+    def _spawn(self, i: int) -> procs.ProcessHandle:
+        handle = procs.ProcessHandle(
+            self._replica_argv(i), env=self._replica_env(i),
+            ready_timeout_s=self.cfg.ready_timeout_s,
+        ).spawn()
+        obs.emit("fabric_spawn", replica=i, pid=handle.pid,
+                 port=handle.ready.get("port"),
+                 generation=handle.ready.get("generation"))
+        return handle
+
+    def start(self) -> "ServingFabric":
+        if self._started:
+            return self
+        obs.emit("fabric_start", replicas=self.cfg.replicas,
+                 ring_slots=self.cfg.ring_slots, index_dir=self.index_dir)
+        for i in range(self.cfg.replicas):
+            handle = self._spawn(i)
+            self._handles.append(handle)
+            self._ports.append(int(handle.ready["port"]))
+        self._started = True
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="fabric-health", daemon=True
+        )
+        self._health_thread.start()
+        self._sup_thread = threading.Thread(
+            target=self._supervise_loop, name="fabric-supervisor", daemon=True
+        )
+        self._sup_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in (self._health_thread, self._sup_thread):
+            if t is not None:
+                t.join(timeout=10.0)
+        self._health_thread = self._sup_thread = None
+        for handle in self._handles:
+            handle.terminate(self.cfg.grace_s)
+        obs.emit("fabric_stop", **self.audit())
+        self._started = False
+
+    def __enter__(self) -> "ServingFabric":
+        return self.start()
+
+    def __exit__(self, *exc: object) -> None:
+        self.stop()
+
+    # ----------------------------------------------------------- plumbing
+
+    def _url(self, i: int, path: str) -> str:
+        with self._lock:
+            port = self._ports[i]
+        return f"http://127.0.0.1:{port}{path}"
+
+    def _get_json(self, i: int, path: str, timeout: float) -> dict:
+        with urllib.request.urlopen(self._url(i, path),
+                                    timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    def _post_json(self, i: int, path: str, doc: dict,
+                   timeout: float) -> dict:
+        req = urllib.request.Request(
+            self._url(i, path), data=json.dumps(doc).encode("utf-8"),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read().decode("utf-8"))
+
+    # ----------------------------------------------------------- queries
+
+    def query(self, terms: Sequence[str], *, ranker: str = "tfidf",
+              timeout: float | None = None) -> tuple[np.ndarray, np.ndarray]:
+        """Route one query; on replica failure re-dispatch to the next
+        sibling on the ring under the SAME request id.  Raises
+        :class:`FabricExhausted` past the retry budget — callers see a
+        served answer or a typed refusal, never a silent drop."""
+        rid = f"{self._rid_prefix}-{next(self._rid_seq)}"
+        key = affinity_key(terms, ranker)
+        with self._lock:
+            self._stats["requests"] += 1
+            self._audit[rid] = 0
+        deadline = None if timeout is None else time.monotonic() + timeout
+        last_err: str | None = None
+        for attempt in range(self.cfg.retry_limit):
+            if deadline is not None and time.monotonic() > deadline:
+                break
+            with self._lock:
+                avoid = self._suspect | self._restarting
+            order = self._ring.route(key, exclude=avoid)
+            # rotate across the HEALTHY candidates (suspects sit at the
+            # back of `order`): a hop that just partitioned must not be
+            # the very next target; with the whole fleet suspect, rotate
+            # over everyone — the supervisor may be respawning them
+            pool = [r for r in order if r not in avoid] or order
+            target = pool[attempt % len(pool)]
+            try:
+                # one chaos-hooked hop: net_partition / net_hang / fail
+                # at this site fault the router→replica link
+                resp = rx.attempt_once(
+                    lambda: self._post_json(
+                        target, "/query",
+                        {"rid": rid, "terms": list(terms), "ranker": ranker},
+                        self.cfg.request_timeout_s,
+                    ),
+                    site=ROUTE_SITE,
+                )
+            except chaos.PartitionError as exc:
+                self._mark_suspect(target, f"partition: {exc}")
+                last_err = str(exc)
+                continue
+            except urllib.error.HTTPError as exc:
+                if exc.code == 400:
+                    body = exc.read().decode("utf-8", "replace")
+                    try:
+                        msg = json.loads(body).get("error", body)
+                    except json.JSONDecodeError:
+                        msg = body
+                    raise ValueError(msg) from exc
+                # 503 = below floor / shutting down: not suspect-worthy
+                # on its own (the poll loop will catch it up) — just try
+                # a sibling and come back later
+                last_err = f"HTTP {exc.code}"
+                with self._lock:
+                    self._stats["retries"] += 1
+                time.sleep(self.cfg.retry_pause_s)
+                continue
+            except Exception as exc:  # noqa: BLE001 — dead/hung replica
+                self._mark_suspect(target, f"{type(exc).__name__}: {exc}")
+                last_err = f"{type(exc).__name__}: {exc}"
+                with self._lock:
+                    self._stats["retries"] += 1
+                time.sleep(self.cfg.retry_pause_s)
+                continue
+            with self._lock:
+                self._audit[rid] += 1
+                self._stats["delivered"] += 1
+                self._suspect.discard(target)
+            return (np.asarray(resp["scores"], dtype=np.float32),
+                    np.asarray(resp["docs"], dtype=np.int32))
+        with self._lock:
+            self._stats["failed"] += 1
+        raise FabricExhausted(
+            f"query {rid} undeliverable after {self.cfg.retry_limit} "
+            f"attempts (last: {last_err})"
+        )
+
+    def _mark_suspect(self, i: int, why: str) -> None:
+        with self._lock:
+            fresh = i not in self._suspect
+            self._suspect.add(i)
+        if fresh:
+            obs.emit("fabric_suspect", replica=i, error=why[:200])
+
+    # ----------------------------------------------------------- health
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.cfg.health_period_s):
+            for i in range(self.cfg.replicas):
+                with self._lock:
+                    if i in self._restarting:
+                        continue
+                try:
+                    status = self._get_json(i, "/status", timeout=2.0)
+                    healthy = bool(status.get("ready"))
+                except Exception:  # noqa: BLE001 — unreachable = unhealthy
+                    status, healthy = None, False
+                with self._lock:
+                    was = i not in self._suspect
+                    if healthy:
+                        self._suspect.discard(i)
+                    else:
+                        self._suspect.add(i)
+                if healthy != was:
+                    obs.emit("fabric_health", replica=i, healthy=healthy)
+                if status is not None:
+                    # per-replica metrics fold: the fleet's numbers land
+                    # in the ROUTER's trace + hub, one gauge per replica
+                    obs.emit("fabric_replica_stats", replica=i,
+                             requests=status.get("requests"),
+                             executions=status.get("executions"),
+                             replays=status.get("replays"),
+                             p50_ms=status.get("p50_ms"),
+                             p99_ms=status.get("p99_ms"),
+                             generation=status.get("generation"),
+                             floor=status.get("floor"))
+                    obs.gauge(f"fabric_replica{i}_requests",
+                              float(status.get("requests") or 0))
+
+    # ----------------------------------------------------------- supervisor
+
+    def _supervise_loop(self) -> None:
+        while not self._stop.wait(self.cfg.poll_s):
+            for i in range(self.cfg.replicas):
+                with self._lock:
+                    if i in self._restarting:
+                        continue
+                handle = self._handles[i]
+                if handle.alive():
+                    with self._lock:
+                        self._down_since.pop(i, None)
+                    continue
+                if not self.cfg.respawn:
+                    self._mark_suspect(i, "dead (respawn disabled)")
+                    continue
+                with self._lock:
+                    t_down = self._down_since.setdefault(i, time.monotonic())
+                try:
+                    fresh = procs.respawn(
+                        handle, site=ROUTE_SITE, replica=i,
+                        spawn=lambda: self._spawn(i),
+                    )
+                except procs.ProcessSpawnError as exc:
+                    self._mark_suspect(i, f"respawn failed: {exc}")
+                    continue
+                recovery_s = time.monotonic() - t_down
+                with self._lock:
+                    self._handles[i] = fresh
+                    self._ports[i] = int(fresh.ready["port"])
+                    self._suspect.discard(i)
+                    self._down_since.pop(i, None)
+                    self._stats["respawns"] += 1
+                obs.emit("fabric_respawn", replica=i, pid=fresh.pid,
+                         port=fresh.ready.get("port"),
+                         recovery_s=round(recovery_s, 3))
+
+    # ----------------------------------------------------------- fleet ops
+
+    def statuses(self, timeout: float = 2.0) -> list[dict | None]:
+        out: list[dict | None] = []
+        for i in range(self.cfg.replicas):
+            try:
+                out.append(self._get_json(i, "/status", timeout=timeout))
+            except Exception:  # noqa: BLE001 — down replica = None
+                out.append(None)
+        return out
+
+    def fleet_generation(self) -> int | None:
+        """The fleet's servable generation: min over ready replicas
+        (None when no replica is ready)."""
+        gens = [s["generation"] for s in self.statuses()
+                if s is not None and s.get("ready")]
+        return min(gens) if gens else None
+
+    def await_fleet_generation(self, generation: int,
+                               timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            statuses = self.statuses()
+            if all(s is not None and s.get("ready")
+                   and (s.get("generation") or 0) >= generation
+                   for s in statuses):
+                return True
+            time.sleep(self.cfg.poll_s)
+        return False
+
+    def rolling_restart(self, *, generation: int | None = None,
+                        timeout: float = 120.0) -> None:
+        """Roll the fleet one replica at a time under a committed floor:
+        (1) wait until EVERY replica serves ≥ G, (2) durably commit the
+        floor at G — from here no replica may come back below it —
+        (3) TERM → respawn → wait-ready each replica while its siblings
+        keep serving.  Queries in flight on the rolling replica fail
+        typed (ServerShutdown → HTTP 503) and re-dispatch to siblings
+        under their original request ids."""
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+            segments as sgm,
+        )
+
+        G = generation
+        if G is None:
+            G = sgm.manifest_version(self.index_dir) or 0
+        if not self.await_fleet_generation(G, timeout=timeout):
+            raise TimeoutError(
+                f"fleet never reached generation {G} within {timeout}s"
+            )
+        commit_floor(self.index_dir, G)
+        obs.emit("fabric_roll_start", floor=G, replicas=self.cfg.replicas)
+        for i in range(self.cfg.replicas):
+            with self._lock:
+                self._restarting.add(i)
+                self._suspect.add(i)  # route around it immediately
+            t0 = time.monotonic()
+            self._handles[i].terminate(self.cfg.grace_s)
+            fresh = self._spawn(i)
+            with self._lock:
+                self._handles[i] = fresh
+                self._ports[i] = int(fresh.ready["port"])
+            # back in rotation only once it serves ≥ the floor
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                try:
+                    s = self._get_json(i, "/status", timeout=2.0)
+                    if s.get("ready") and (s.get("generation") or 0) >= G:
+                        break
+                except Exception:  # noqa: BLE001 — still coming up
+                    pass
+                time.sleep(self.cfg.poll_s)
+            else:
+                raise TimeoutError(
+                    f"replica {i} never reached floor {G} after restart"
+                )
+            with self._lock:
+                self._restarting.discard(i)
+                self._suspect.discard(i)
+                self._stats["rolled"] += 1
+            obs.emit("fabric_rolled", replica=i, floor=G,
+                     restart_s=round(time.monotonic() - t0, 3))
+        obs.emit("fabric_roll_done", floor=G)
+
+    def kill_replica(self, i: int) -> int | None:
+        """SIGKILL replica ``i`` (the bench/soak chaos hook); returns the
+        killed pid.  The supervisor detects and respawns it."""
+        handle = self._handles[i]
+        pid = handle.pid
+        handle.kill()
+        obs.emit("fabric_kill", replica=i, pid=pid)
+        return pid
+
+    def audit(self) -> dict:
+        """The router-side delivery audit: requests / delivered / failed
+        (= dropped candidates) / retries / respawns, plus double_served =
+        request ids with more than one accepted delivery (structurally 0:
+        the retry loop stops at the first success, and replicas replay —
+        not re-execute — a duplicate rid)."""
+        with self._lock:
+            # Counter semantics drop zero-valued keys; the audit's keys
+            # are ALWAYS present so callers (and diffs) never KeyError
+            out = {k: int(self._stats.get(k, 0))
+                   for k in ("requests", "delivered", "retries", "failed",
+                             "respawns", "rolled")}
+            out["dropped"] = out["failed"]
+            out["double_served"] = sum(
+                1 for n in self._audit.values() if n > 1
+            )
+        return out
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    """Module entry: ``--replica`` runs a replica process; the router is
+    a library (ServingFabric) driven by the soak/bench/CI harnesses."""
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "--replica":
+        return replica_main(argv[1:])
+    print("usage: fabric --replica INDEX_DIR [options]", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

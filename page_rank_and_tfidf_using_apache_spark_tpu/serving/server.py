@@ -229,6 +229,15 @@ def impacted_pad_plan(
 RANKERS = ("tfidf", "bm25", "prior")
 
 
+class ServerShutdown(RuntimeError):
+    """The server stopped (graceful drain) while this request was in
+    flight, or a submit arrived after stop().  Typed so callers — the
+    fabric router rolling a replica, a soak client — can tell an orderly
+    shutdown (re-dispatch elsewhere / re-submit) from a real serving
+    failure: a stopped server never hangs a client, it fails fast with
+    this."""
+
+
 class _Pending:
     """One in-flight request: a tiny future the drain thread resolves."""
 
@@ -417,6 +426,7 @@ class TfidfServer:
         self._queue: queue.Queue = queue.Queue(maxsize=cfg.queue_depth)
         self._thread: threading.Thread | None = None
         self._started = False
+        self._stopped = False  # distinguishes drained from never-started
         self._cache: collections.OrderedDict[bytes, tuple] = collections.OrderedDict()
         self._lock = threading.Lock()  # cache + stats + live segment list
         # Orders submit()'s {started-check, enqueue} against stop()'s flag
@@ -491,6 +501,7 @@ class TfidfServer:
             )
             self._segs = self._build_segs(segset, self.k)
         self._started = True
+        self._stopped = False
         if warm:
             self.warmup()
         self._thread = threading.Thread(
@@ -733,8 +744,12 @@ class TfidfServer:
                  warm_s=round(time.perf_counter() - t0, 4))
 
     def stop(self) -> None:
+        """Graceful shutdown: stop accepting, drain what's queued, fail
+        anything that slipped past the drain with :class:`ServerShutdown`
+        — clients always get an answer or a typed refusal, never a hang."""
         with self._submit_lock:
             self._started = False  # new submits refuse from here on
+            self._stopped = True
         if self._thread is not None:
             self._queue.put(_STOP)
             self._thread.join()
@@ -748,7 +763,7 @@ class TfidfServer:
             except queue.Empty:
                 break
             if isinstance(item, _Pending):
-                item._fail(RuntimeError("server stopped"))
+                item._fail(ServerShutdown("server stopped"))
         obs.emit("serve_stop", **{k: int(v) for k, v in self._stats.items()})
 
     def __enter__(self) -> "TfidfServer":
@@ -828,6 +843,8 @@ class TfidfServer:
             # sentinel (served, or failed by the leftover drain) — never
             # silently dropped with a hanging future
             if not self._started:
+                if self._stopped:
+                    raise ServerShutdown("server stopped")
                 raise RuntimeError("server not started")
             self._queue.put(pending)  # graftlint: disable=blocking-under-lock (deliberate: backpressure belongs inside the started-check; the drain consumes without ever taking _submit_lock, so a blocked put always unblocks — see the _submit_lock comment above)
         with self._lock:

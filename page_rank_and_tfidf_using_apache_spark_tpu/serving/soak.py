@@ -873,6 +873,406 @@ def serving_latest_version(index_dir: str) -> int | None:
     return int(os.path.basename(path).lstrip("v"))
 
 
+# ==========================================================================
+# fleet soak (ISSUE 17): the same SLO-scored scenario run against the
+# multi-PROCESS serving fabric instead of one in-process server
+# ==========================================================================
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSoakConfig:
+    """One fleet-soak scenario: N replica processes behind the consistent-
+    hash router, continuous delta-segment ingest (each replica hot-swaps
+    via its own manifest poll loop), closed-loop clients through
+    ``ServingFabric.query`` (sibling re-dispatch under the same request
+    id), one replica SIGKILL mid-run, and one rolling restart under a
+    committed generation floor — scored on the SAME SLO record shape as
+    :func:`run_soak` so ``tools/trace_report`` / ``tools/trace_diff``
+    work unchanged."""
+
+    duration_s: float = 45.0  # * GRAFT_SOAK_DURATION_S
+    qps: float = 12.0  # * GRAFT_SOAK_QPS — aggregate closed-loop target
+    replicas: int = 2  # * GRAFT_FABRIC_REPLICAS
+    slo_p99_ms: float = 2000.0  # * GRAFT_SOAK_SLO_P99_MS — cross-process
+    # hop + retry ladder: looser than the in-process soak by design
+    availability_target: float = 0.99  # * GRAFT_SOAK_SLO_AVAILABILITY
+    clients: int = 2
+    window_s: float = 120.0  # rolling SLO window
+    rebuild_every_s: float = 10.0  # delta-segment seal/commit cadence
+    chunk_interval_s: float = 0.5
+    kill_at_s: float | None = None  # replica-0 SIGKILL; default duration/3
+    roll_at_s: float | None = None  # rolling restart; default 2·duration/3
+    request_timeout_s: float = 30.0  # client-side budget per logical query
+    grace_s: float = 20.0
+    seed: int = 11
+    vocab_bits: int = 12
+    docs_per_chunk: int = 24
+    tokens_per_doc: int = 40
+    chunk_tokens: int = 1 << 12
+    bootstrap_chunks: int = 3
+    top_k: int = 10
+    scoring: str = "coo"
+    retry_limit: int = 120  # router re-dispatch budget per request
+    retry_pause_s: float = 0.25
+
+    def __post_init__(self) -> None:
+        if self.duration_s <= 0 or self.qps <= 0 or self.clients < 1:
+            raise ValueError("duration_s, qps and clients must be positive")
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        if not 0.0 < self.availability_target < 1.0:
+            raise ValueError("availability_target must be in (0, 1)")
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "FleetSoakConfig":
+        env: dict[str, Any] = {}
+        raw = os.environ.get("GRAFT_SOAK_DURATION_S")
+        if raw:
+            env["duration_s"] = float(raw)
+        raw = os.environ.get("GRAFT_SOAK_QPS")
+        if raw:
+            env["qps"] = float(raw)
+        raw = os.environ.get("GRAFT_FABRIC_REPLICAS")
+        if raw:
+            env["replicas"] = int(raw)
+        raw = os.environ.get("GRAFT_SOAK_SLO_P99_MS")
+        if raw:
+            env["slo_p99_ms"] = float(raw)
+        raw = os.environ.get("GRAFT_SOAK_SLO_AVAILABILITY")
+        if raw:
+            env["availability_target"] = float(raw)
+        env.update(overrides)
+        return cls(**env)
+
+
+class _FleetSoak:
+    """One fleet-soak run.  The supervisor owns the calling thread and
+    fires the chaos timeline (SIGKILL, rolling restart); the ingest and
+    client workers are daemon threads.  Cross-thread counters live under
+    ``self._lock``; the fabric's own state is behind its own lock."""
+
+    def __init__(self, cfg: FleetSoakConfig, index_dir: str):
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+            fabric as fab,
+        )
+
+        self.cfg = cfg
+        self.index_dir = index_dir
+        self._fab_mod = fab
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._client_stop = threading.Event()
+        self.fabric: fab.ServingFabric | None = None
+        self._client_results: dict[int, list[dict]] = {}
+        self._chunks_arrived = 0
+        self._tokens_arrived = 0
+        self._seals = 0
+        self._docs_total = 0
+        self._t0 = 0.0
+        # router-side delivery ledger, snapshotted by run() right before
+        # fabric.stop() tears the fleet down
+        self._last_audit: dict | None = None
+        self.hub = MetricsHub(
+            window_s=cfg.window_s,
+            latency_slo_s=cfg.slo_p99_ms / 1e3,
+            availability_target=cfg.availability_target,
+        )
+
+    def _fleet_stream_cfg(self) -> TfidfConfig:
+        cfg = self.cfg
+        return tuned_config(
+            TfidfConfig, load_tuned_profile(),
+            vocab_bits=cfg.vocab_bits, chunk_tokens=cfg.chunk_tokens,
+            pack_target_tokens=cfg.chunk_tokens,
+        )
+
+    def _fleet_seal_delta(self, delta: list[list[str]],
+                    scfg: TfidfConfig) -> int | None:
+        """Seal the accumulated delta as one immutable segment and commit
+        it.  Nobody swaps here: every REPLICA notices the new manifest
+        generation on its own poll loop and hot-swaps independently —
+        that decoupling is the point of the fabric."""
+        out = run_tfidf_streaming(iter(delta), scfg,
+                                  metrics=MetricsRecorder())
+        if out.n_docs < 1:
+            return None
+        with self._lock:
+            base = self._docs_total
+        ref = sgm.seal_segment(
+            self.index_dir, out, scfg, doc_base=base,
+            ranks=np.ones(out.n_docs, np.float32), bm25=Bm25Config(),
+        )
+        version = sgm.commit_append(self.index_dir, ref,
+                                    scfg.config_hash())
+        with self._lock:
+            self._docs_total = base + out.n_docs
+            self._seals += 1
+        obs.emit("fleet_seal", version=version, segment=ref.name,
+                 doc_base=base, n_docs=out.n_docs)
+        return version
+
+    def _fleet_ingest_loop(self, gen: Iterator[list[str]]) -> None:
+        cfg = self.cfg
+        scfg = self._fleet_stream_cfg()
+        pending: list[list[str]] = []
+        next_seal = time.perf_counter() + cfg.rebuild_every_s
+        while not self._stop.is_set():
+            docs = next(gen)
+            with self._lock:
+                self._chunks_arrived += 1
+                self._tokens_arrived += sum(len(d.split()) for d in docs)
+            pending.append(docs)
+            if time.perf_counter() >= next_seal and pending:
+                delta, pending = pending, []
+                try:
+                    self._fleet_seal_delta(delta, scfg)
+                except Exception as exc:  # noqa: BLE001 — the delta
+                    # rejoins the queue; the next tick retries it
+                    pending = delta + pending
+                    obs.emit("fleet_seal_failed",
+                             error=f"{type(exc).__name__}: {exc}"[:160])
+                next_seal = time.perf_counter() + cfg.rebuild_every_s
+            else:
+                self._stop.wait(cfg.chunk_interval_s)
+
+    def _fleet_client_loop(self, idx: int) -> None:
+        cfg = self.cfg
+        rng = np.random.default_rng(cfg.seed * 1013 + idx)
+        interval = cfg.clients / cfg.qps
+        next_t = time.perf_counter() + float(rng.uniform(0, interval))
+        results: list[dict] = []
+        with self._lock:
+            self._client_results[idx] = results
+        while not self._client_stop.is_set():
+            now = time.perf_counter()
+            if now < next_t:
+                self._client_stop.wait(min(next_t - now, 0.05))
+                continue
+            next_t = max(next_t + interval, now)
+            ranker = "tfidf" if rng.random() < 0.7 else "bm25"
+            terms = [f"w{int(rng.zipf(1.3)) % _VOCAB_WORDS}"
+                     for _ in range(int(rng.integers(2, 5)))]
+            rec: dict = {"ranker": ranker, "ok": False}
+            t_begin = time.perf_counter()
+            err: str | None = None
+            try:
+                fabric = self.fabric
+                if fabric is None:
+                    raise RuntimeError("no fabric")
+                # the fabric retries internally: sibling re-dispatch
+                # under the SAME request id, so a replica dying mid-query
+                # is invisible here (or a typed FabricExhausted)
+                fabric.query(terms, ranker=ranker,
+                             timeout=cfg.request_timeout_s)
+                rec["ok"] = True
+            except Exception as exc:  # noqa: BLE001 — exhausted/refused
+                err = f"{type(exc).__name__}: {exc}"[:160]
+            rec["e2e_s"] = time.perf_counter() - t_begin
+            # the ROUTER process is where fleet latency is observed:
+            # feed the hub through the same serve_request event the
+            # in-process server publishes (TelemetrySink contract)
+            obs.emit("serve_request", total_s=rec["e2e_s"], error=err)
+            results.append(rec)
+
+    def run(self) -> dict:
+        cfg = self.cfg
+        sink = TelemetrySink(self.hub)
+        obs.bus().attach(sink)
+        gen = _doc_chunks(cfg)
+        fab = self._fab_mod
+        recoveries: list[dict] = []
+        kills = 0
+        roll: dict | None = None
+        try:
+            with obs.span("fleet.bootstrap"):
+                boot = [next(gen) for _ in range(cfg.bootstrap_chunks)]
+                with self._lock:
+                    self._chunks_arrived += cfg.bootstrap_chunks
+                    self._tokens_arrived += sum(
+                        len(d.split()) for c in boot for d in c
+                    )
+                self._fleet_seal_delta(boot, self._fleet_stream_cfg())
+                self.fabric = fab.ServingFabric(
+                    self.index_dir,
+                    fab.FabricConfig(
+                        replicas=cfg.replicas, top_k=cfg.top_k,
+                        scoring=cfg.scoring,
+                        retry_limit=cfg.retry_limit,
+                        retry_pause_s=cfg.retry_pause_s,
+                        grace_s=cfg.grace_s,
+                    ),
+                ).start()
+            self._t0 = time.perf_counter()
+            deadline = self._t0 + cfg.duration_s
+            kill_at = (cfg.kill_at_s if cfg.kill_at_s is not None
+                       else cfg.duration_s / 3.0)
+            roll_at = (cfg.roll_at_s if cfg.roll_at_s is not None
+                       else 2.0 * cfg.duration_s / 3.0)
+            obs.emit("fleet_soak_start", duration_s=cfg.duration_s,
+                     qps=cfg.qps, replicas=cfg.replicas,
+                     clients=cfg.clients)
+            threads = [
+                threading.Thread(target=self._fleet_ingest_loop, args=(gen,),
+                                 name="fleet-ingest", daemon=True),
+            ] + [
+                threading.Thread(target=self._fleet_client_loop, args=(i,),
+                                 name=f"fleet-client-{i}", daemon=True)
+                for i in range(cfg.clients)
+            ]
+            for t in threads:
+                t.start()
+            clients = threads[1:]
+
+            killed_pid: int | None = None
+            t_kill: float | None = None
+            victim = 0
+            while time.perf_counter() < deadline:
+                now_s = time.perf_counter() - self._t0
+                if kill_at is not None and now_s >= kill_at:
+                    kill_at = None
+                    killed_pid = self.fabric.kill_replica(victim)
+                    t_kill = time.perf_counter()
+                    kills += 1
+                if t_kill is not None:
+                    # recovery = SIGKILL → the victim's REPLACEMENT is
+                    # ready (detection latency included, as in run_soak)
+                    s = self.fabric.statuses()[victim]
+                    if (s is not None and s.get("ready")
+                            and s.get("pid") != killed_pid):
+                        recoveries.append({
+                            "at_s": round(now_s, 3),
+                            "reason": "proc_kill",
+                            "time_to_recover_s": round(
+                                time.perf_counter() - t_kill, 3),
+                        })
+                        t_kill = None
+                if roll_at is not None and now_s >= roll_at:
+                    roll_at = None
+                    t_roll = time.perf_counter()
+                    try:
+                        # blocks in THIS thread; clients keep hammering
+                        # the fleet from theirs throughout the roll
+                        self.fabric.rolling_restart(timeout=60.0)
+                        roll = {"ok": True, "roll_s": round(
+                            time.perf_counter() - t_roll, 3)}
+                    except Exception as exc:  # noqa: BLE001 — a failed
+                        # roll is a scored outcome, not a crashed soak
+                        roll = {"ok": False,
+                                "error": f"{type(exc).__name__}: {exc}"[:160]}
+                time.sleep(0.1)
+
+            actual_s = time.perf_counter() - self._t0
+            self._client_stop.set()
+            for c in clients:
+                c.join(timeout=cfg.request_timeout_s + cfg.grace_s)
+            self._stop.set()
+            threads[0].join(timeout=60.0)
+            # snapshot the ledger BEFORE stop() tears the fleet down
+            self._last_audit = self.fabric.audit()
+            return self._score(actual_s, recoveries, kills, roll)
+        finally:
+            self._stop.set()
+            self._client_stop.set()
+            fabric, self.fabric = self.fabric, None
+            if fabric is not None:
+                fabric.stop()
+            obs.bus().detach(sink)
+
+    def _score(self, actual_s: float, recoveries: list[dict],
+               kills: int, roll: dict | None) -> dict:
+        import jax
+
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+            fabric as fab,
+        )
+
+        with self._lock:
+            per_client = dict(self._client_results)
+            chunks_arrived = self._chunks_arrived
+            tokens_arrived = self._tokens_arrived
+            seals = self._seals
+        recs = [r for results in per_client.values() for r in results]
+        e2e_ok = sorted(r["e2e_s"] for r in recs if r["ok"])
+        mixed: dict[str, int] = {"tfidf": 0, "bm25": 0, "prior": 0}
+        for r in recs:
+            mixed[r["ranker"]] += 1
+        # the cross-PROCESS delivery audit: the router's request-id
+        # ledger (a replica that died mid-query and its sibling retry
+        # share one rid; replicas replay, never re-execute)
+        audit = self._last_audit or {}
+        snap = self.hub.snapshot()
+        win = snap["latency_s"]["window"]
+        counters = snap["counters"]
+        record = {
+            "duration_s": round(actual_s, 3),
+            "requests": len(recs),
+            "attempts": int(audit.get("requests", 0)
+                            + audit.get("retries", 0)),
+            "qps": round(len(e2e_ok) / actual_s, 3) if actual_s > 0 else 0.0,
+            "served_p50_ms": _ms(win["p50"]),
+            "served_p95_ms": _ms(win["p95"]),
+            "served_p99_ms": _ms(win["p99"]),
+            "client_e2e_p99_ms": _ms(percentile(e2e_ok, 0.99)),
+            "error_budget": snap["budgets"],
+            "errors": int(counters.get("serve.errors", {})
+                          .get("total", 0)),
+            "recovery": {
+                "losses_injected": kills,
+                "recoveries": recoveries,
+                "time_to_recover_s": (
+                    max(r["time_to_recover_s"] for r in recoveries)
+                    if recoveries else None
+                ),
+            },
+            "dropped": int(audit.get("dropped", 0)),
+            "double_served": int(audit.get("double_served", 0)),
+            "ingest": {
+                "chunks": chunks_arrived,
+                "tokens": tokens_arrived,
+                "mode": "fleet-segments",
+                "rebuilds": seals,
+                "index_version": serving_latest_version(self.index_dir),
+            },
+            "fleet": {
+                "replicas": self.cfg.replicas,
+                "respawns": int(audit.get("respawns", 0)),
+                "rolled": int(audit.get("rolled", 0)),
+                "roll": roll,
+                "floor": fab.read_floor(self.index_dir),
+                "retries": int(audit.get("retries", 0)),
+            },
+            "mixed_traffic": mixed,
+            "slo_targets": {
+                "p99_ms": self.cfg.slo_p99_ms,
+                "availability": self.cfg.availability_target,
+                "window_s": self.cfg.window_s,
+            },
+            "backend": jax.default_backend(),
+        }
+        obs.emit("slo", **record)
+        return record
+
+
+def run_fleet_soak(cfg: FleetSoakConfig | None = None, *,
+                   index_dir: str | None = None) -> dict:
+    """Run one FLEET soak scenario — N replica processes behind the
+    consistent-hash router, one SIGKILL and one rolling restart under
+    load — and return its SLO record (also published as an ``slo`` event
+    into any active trace, with a ``fleet`` sub-dict carrying the
+    respawn/roll/floor read-outs)."""
+    cfg = cfg or FleetSoakConfig.from_env()
+    tmp = None
+    if index_dir is None:
+        tmp = tempfile.mkdtemp(prefix="fleet_idx_")
+        index_dir = tmp
+    try:
+        with obs.span("fleet.run", duration_s=cfg.duration_s):
+            return _FleetSoak(cfg, index_dir).run()
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_soak(cfg: SoakConfig | None = None, *,
              index_dir: str | None = None) -> dict:
     """Run one production-soak scenario and return its SLO record (also

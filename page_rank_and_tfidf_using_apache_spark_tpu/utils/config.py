@@ -84,6 +84,11 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         # the knob resolution ladder loads instead of the committed
         # per-backend default ("off" or empty disables profile loading
         # entirely: every knob falls back to TUNABLE_DEFAULTS)
+        "GRAFT_FABRIC_BUDGET_S",  # tools/ci.sh wall-clock budget for the
+        # fabric smoke (N=2 replica fleet, one SIGKILL mid-traffic,
+        # recovery asserted with dropped=0; read in bash; default 25s)
+        "GRAFT_FABRIC_REPLICAS",  # serving/fabric.py: replica-fleet size
+        # the fleet soak / FabricConfig.from_env defaults to (default 2)
     }
 )
 
@@ -133,6 +138,8 @@ DEGRADE_LADDER: tuple = (
     "mesh_shrink",  # rebuild the mesh over surviving devices (pow2 shrink)
     "single_device",  # the 1-device end of the shrink chain
     "cpu",  # re-lower on the CPU backend (single-chip paths)
+    "respawn",  # replace a dead replica PROCESS (resilience/process.py):
+    # past every in-process rung — recovery belongs to the supervisor
 )
 
 
@@ -193,6 +200,32 @@ THREAD_REGISTRY: tuple = (
     ("soak-client-*",
      "page_rank_and_tfidf_using_apache_spark_tpu/serving/soak.py",
      ("_Soak._lock",)),
+    ("fleet-ingest",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/soak.py",
+     ("_FleetSoak._lock",
+      # delta-segment commits go through the segments module commit lock
+      "page_rank_and_tfidf_using_apache_spark_tpu/serving/segments.py::"
+      "_COMMIT_LOCK")),
+    ("fleet-client-*",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/soak.py",
+     ("_FleetSoak._lock",
+      # fabric.query folds delivery stats under the router's own lock
+      "page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py::"
+      "ServingFabric._lock")),
+    ("proc-stdout-drain",
+     "page_rank_and_tfidf_using_apache_spark_tpu/resilience/process.py",
+     ()),  # drains a supervised child's stdout so it can't fill the pipe
+    ("fabric-replica-poll",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py",
+     # floor/generation state under the replica's own lock; the hot swap
+     # itself goes through the server's refresh path
+     ("_Replica._lock",)),
+    ("fabric-health",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py",
+     ("ServingFabric._lock",)),  # suspect set + per-replica stats fold
+    ("fabric-supervisor",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py",
+     ("ServingFabric._lock",)),  # handle/port swap on respawn
 )
 
 

@@ -314,8 +314,19 @@ def test_stop_fails_raced_submit_instead_of_hanging(oracle_index):
     srv._queue.put(leftover)  # may land after the _STOP sentinel
     srv.stop()
     assert leftover.done  # resolved OR failed by the leftover drain
-    with pytest.raises(RuntimeError, match="not started"):
+    # post-stop submits refuse with the TYPED shutdown error (still a
+    # RuntimeError) so fabric/soak callers can tell an orderly stop from
+    # a server that never started
+    from page_rank_and_tfidf_using_apache_spark_tpu.serving.server import (
+        ServerShutdown,
+    )
+
+    with pytest.raises(ServerShutdown, match="server stopped"):
         srv.submit(["node"])
+    with pytest.raises(RuntimeError, match="not started"):
+        serving.TfidfServer(
+            oracle_index, serving.ServeConfig(top_k=2)
+        ).submit(["node"])
 
 
 def test_query_truncation_and_empty(oracle_index):

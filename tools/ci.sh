@@ -280,6 +280,89 @@ print("soak smoke: OK "
       f"{rec['ingest']['rebuilds']} rebuild(s))")
 EOF
 
+echo "== fabric smoke (N=${GRAFT_FABRIC_REPLICAS:-2} replica fleet: SIGKILL mid-traffic + respawn, budget ${GRAFT_FABRIC_BUDGET_S:-25}s) =="
+# The ISSUE 17 serving fabric as a bounded CI gate: an N-replica fleet
+# of real child processes mmap-loads the same sealed segments, one
+# replica is hard-SIGKILLed mid-traffic, and the router's sibling retry
+# + supervisor respawn must deliver every request exactly once
+# (dropped=0, double_served=0) — then the run's trace must parse into
+# tools/trace_report.py's fabric section (replicas/kills/respawns/totals).
+t0=$(date +%s)
+if ! env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    GRAFT_FABRIC_REPLICAS="${GRAFT_FABRIC_REPLICAS:-2}" \
+    FABRIC_SMOKE_DIR="$smoke_dir" \
+    python - > "$smoke_dir/fabric.log" 2>&1 <<'EOF'
+import importlib.util
+import os
+import time
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import fabric
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import segments as sgm
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    Bm25Config,
+    TfidfConfig,
+)
+
+d = os.path.join(os.environ["FABRIC_SMOKE_DIR"], "fabidx")
+scfg = TfidfConfig(vocab_bits=9)
+docs = [f"alpha beta doc{i} shared word graph node" for i in range(8)]
+out = run_tfidf(docs, scfg)
+ref = sgm.seal_segment(d, out, scfg, doc_base=0,
+                       ranks=np.ones(out.n_docs, np.float32),
+                       bm25=Bm25Config())
+sgm.commit_append(d, ref, scfg.config_hash())
+n = int(os.environ.get("GRAFT_FABRIC_REPLICAS", "2"))
+trace_dir = os.path.join(os.environ["FABRIC_SMOKE_DIR"], "fabtrace")
+with obs.run("fabric_smoke", trace_dir=trace_dir) as r:
+    cfg = fabric.FabricConfig(replicas=n, poll_s=0.1, health_period_s=0.2,
+                              retry_limit=100, retry_pause_s=0.1,
+                              grace_s=10.0)
+    with fabric.ServingFabric(d, cfg) as fab:
+        for _ in range(5):
+            fab.query(["alpha", "beta"])
+        fab.kill_replica(0)  # hard SIGKILL mid-traffic
+        for _ in range(10):
+            fab.query(["shared", "word"])
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            if (fab.audit()["respawns"] >= 1
+                    and all(s is not None and s.get("ready")
+                            for s in fab.statuses())):
+                break
+            time.sleep(0.2)
+        audit = fab.audit()
+assert audit["respawns"] >= 1, audit
+assert audit["dropped"] == 0 and audit["double_served"] == 0, audit
+spec = importlib.util.spec_from_file_location("tr", "tools/trace_report.py")
+tr = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(tr)
+rep = tr.report(r.trace_path)
+fb = rep["fabric"]
+assert fb is not None and fb["replicas"] == n, fb
+assert fb["kills"] >= 1 and len(fb["respawns"]) >= 1, fb
+assert fb["totals"]["dropped"] == 0, fb
+assert fb["totals"]["double_served"] == 0, fb
+print(f"fabric smoke: OK — N={n} fleet survived a SIGKILL "
+      f"({audit['requests']} req, {audit['retries']} sibling retries, "
+      f"{len(fb['respawns'])} respawn(s), dropped=0, double_served=0)")
+EOF
+then
+    echo "FAIL: fabric smoke; its output:" >&2
+    cat "$smoke_dir/fabric.log" >&2
+    exit 1
+fi
+tail -1 "$smoke_dir/fabric.log"
+dt=$(( $(date +%s) - t0 ))
+echo "fabric smoke: ${dt}s"
+if [ "$dt" -gt "${GRAFT_FABRIC_BUDGET_S:-25}" ]; then
+    echo "FAIL: fabric smoke exceeded its ${GRAFT_FABRIC_BUDGET_S:-25}s budget (${dt}s) — replica spawn/respawn stopped being interactive" >&2
+    exit 1
+fi
+
 echo "== segment smoke (seal → serve → post-start commit → merge under *:fail@%5, budget ${GRAFT_SEG_BUDGET_S:-15}s) =="
 # The ISSUE 13 ingest→servable path as a bounded CI gate: seal a delta
 # segment, serve it via impacted-list scoring, commit a SECOND segment

@@ -27,6 +27,14 @@ Scenarios replay the three commit protocols over synthetic segments:
                  including the generation-deferred GC deletes
 - ``merge``    — a full ``SegmentMerger.merge_once`` tick (merge + seal +
                  commit_replace)
+- ``floor``    — the serving fabric's generation-floor commit
+                 (``serving.fabric.commit_floor``: the rolling-restart
+                 barrier no replica may serve below).  A single-rename
+                 protocol BY DESIGN — one staged tmp + ``durable_replace``
+                 — so its probe is allowed exactly one boundary: the
+                 harness proves a kill at that boundary leaves the OLD
+                 floor serving (a restarted replica keeps refusing
+                 pre-floor artifacts), never a torn floor file.
 
 The kill mechanism patches ``os.replace`` / ``os.unlink`` /
 ``shutil.rmtree`` in the child to deliver ``SIGKILL`` *before* the N-th
@@ -58,7 +66,13 @@ import sys
 import tempfile
 import time
 
-_SCENARIOS = ("append", "replace", "merge")
+_SCENARIOS = ("append", "replace", "merge", "floor")
+
+# Write-boundary floor per scenario probe: every manifest commit protocol
+# spans multiple reader-visible mutations, but the generation-floor
+# commit is one atomic rename by design — that atomicity is the property
+# under test, not a shrunken protocol.
+_MIN_BOUNDARIES = {"floor": 1}
 
 
 # ===========================================================================
@@ -149,6 +163,14 @@ def worker_setup(base: str, scenario: str) -> int:
         refs.append(ref)
         doc_base += out.n_docs
     state["doc_base"] = doc_base
+    if scenario == "floor":
+        # a replica restarted mid-rolling-swap reads THIS file to decide
+        # what it may serve; the op advances it to the next generation
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+            fabric as fab,
+        )
+
+        fab.commit_floor(d, 1)
     if scenario in ("replace", "merge"):
         # one COMMITTED merge so the op-window commit_replace carries
         # generation-deferred deletes (it GCs what THIS commit replaced)
@@ -209,6 +231,12 @@ def worker_op(base: str, scenario: str, kill_at: int) -> int:
         if not merger.merge_once():
             print("merge_once found nothing to merge", file=sys.stderr)
             return 1
+    elif scenario == "floor":
+        from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+            fabric as fab,
+        )
+
+        fab.commit_floor(d, 2)
     else:
         print(f"unknown scenario {scenario}", file=sys.stderr)
         return 1
@@ -261,9 +289,19 @@ def worker_verify(base: str) -> int:
         segments as sgm,
     )
 
+    from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
+        fabric as fab,
+    )
+
     d = _idx(base)
     segset = sgm.load_segment_set(d)  # must ALWAYS load: torn set = crash
     h = hashlib.sha256()
+    # the generation floor is part of what serving reads (a replica below
+    # it refuses queries): a kill around the floor commit must leave the
+    # old floor or the new floor in the hash, never anything else —
+    # read_floor maps a missing/unparseable file to 0, so torn JSON would
+    # show up as a third hash and fail the pre-or-post check
+    h.update(str(fab.read_floor(d)).encode())
     h.update(str(segset.n_docs).encode())
     h.update(np.ascontiguousarray(segset.df_global).tobytes())
     for seg in segset.segments:
@@ -362,7 +400,7 @@ def run_scenario(base_dir: str, scenario: str,
     post_hash = _run_worker("verify", probe, gc=False)["hash"]
     if pre_hash == post_hash:
         raise RuntimeError(f"{scenario}: op changed nothing — bad scenario")
-    if boundaries < 2:
+    if boundaries < _MIN_BOUNDARIES.get(scenario, 2):
         raise RuntimeError(
             f"{scenario}: only {boundaries} boundaries — protocol shrank?")
 

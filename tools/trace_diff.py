@@ -34,6 +34,13 @@ fails the diff.  Rounds BEFORE the gauge existed carry no map, so the
 old-round fallback skips cleanly; a new round losing the map while the
 old one had it is flagged like the other gates.
 
+Since ISSUE 17 the same discipline covers the serving fleet's numbers
+(``extra.fabric_qps`` / ``extra.fabric_recovery_s`` and the cross-process
+``extra.fabric_dropped`` / ``extra.fabric_double_served`` audit): fleet
+QPS falling past ``--threshold``, respawn recovery growing past it, or
+ANY dropped/double-served increase fails the diff; a round losing its
+fabric numbers while the old one had them is flagged.
+
 Since ISSUE 16 the new round's **tuned-profile provenance** is checked
 on its own (``extra.tuned_profile.backend`` vs ``extra.backend``): a
 round whose knobs came from a profile stamped for a different backend
@@ -264,6 +271,99 @@ def diff_comm(
     return rows
 
 
+# Minimum absolute growth (seconds) a fleet-recovery regression must also
+# clear: respawn latency includes a fresh interpreter + index mmap, which
+# jitters by a second or two on a loaded box.
+FABRIC_MIN_RECOVERY_DELTA_S = 2.0
+
+
+def load_fabric(path: str) -> dict | None:
+    """Fleet numbers riding a BENCH round (ISSUE 17): the always-present
+    ``extra.fabric_qps`` map (per-fleet-size saturated QPS), the measured
+    SIGKILL→respawned ``extra.fabric_recovery_s``, and the cross-process
+    delivery audit ``extra.fabric_dropped`` / ``extra.fabric_double_served``
+    (all null on a failed fabric child).  None when the round predates the
+    fabric bench — the old-round fallback that arms the gate on the first
+    new round."""
+    if path.endswith(".jsonl"):
+        return None
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    extra = record.get("extra", {})
+    if "fabric_qps" not in extra:
+        return None
+    return {
+        "qps": extra.get("fabric_qps"),
+        "recovery_s": extra.get("fabric_recovery_s"),
+        "dropped": extra.get("fabric_dropped"),
+        "double_served": extra.get("fabric_double_served"),
+    }
+
+
+def diff_fabric(
+    old: dict | None, new: dict | None, threshold: float
+) -> list[dict]:
+    """Fleet regression rows, mirroring the SLO gate: per-fleet-size QPS
+    falling relatively past ``threshold``, respawn recovery growing past
+    ``threshold`` (over an absolute jitter floor), and the cross-process
+    dropped / double-served audit as invariants (any increase regresses).
+    A round losing its fabric numbers while the old one had them is
+    itself flagged; null values (failed fabric child) on either side skip
+    the comparison — the bench already recorded the failure."""
+    if old is None:
+        return []
+    if new is None:
+        return [{
+            "key": "fabric.missing",
+            "old": "present",
+            "new": None,
+            "why": "the old round carried fleet (fabric) numbers and the "
+                   "new one does not — the round lost its fabric bench",
+        }]
+    rows: list[dict] = []
+    o_qps = old.get("qps") if isinstance(old.get("qps"), dict) else {}
+    n_qps = new.get("qps") if isinstance(new.get("qps"), dict) else {}
+    for k in sorted(set(o_qps) & set(n_qps)):
+        o, n = o_qps[k], n_qps[k]
+        if o is None or n is None:
+            continue
+        if n < o * (1.0 - threshold):
+            rows.append({
+                "key": f"fabric.qps.{k}",
+                "old": o,
+                "new": n,
+                "why": f"fleet QPS at {k} fell to "
+                       f"{n / max(o, 1e-9):.2f}x of the old round",
+            })
+    o_r, n_r = old.get("recovery_s"), new.get("recovery_s")
+    if (o_r is not None and n_r is not None
+            and n_r > o_r * (1.0 + threshold)
+            and n_r - o_r > FABRIC_MIN_RECOVERY_DELTA_S):
+        rows.append({
+            "key": "fabric.recovery_s",
+            "old": o_r,
+            "new": n_r,
+            "why": f"replica respawn recovery grew "
+                   f"{n_r / max(o_r, 1e-9):.2f}x",
+        })
+    for key in ("dropped", "double_served"):
+        o_v, n_v = old.get(key), new.get(key)
+        if isinstance(o_v, int) and isinstance(n_v, int) and n_v > o_v:
+            rows.append({
+                "key": f"fabric.{key}",
+                "old": o_v,
+                "new": n_v,
+                "why": f"cross-process {key} requests appeared — an "
+                       "invariant, not a knob",
+            })
+    return rows
+
+
 def load_tuned_stamp(path: str) -> dict | None:
     """Tuned-profile provenance riding a BENCH round: the backend the
     committed profile was stamped with (``extra.tuned_profile.backend``,
@@ -431,12 +531,15 @@ def main(argv: list[str] | None = None) -> int:
                               load_served_p99(args.new), args.threshold)
     comm_rows = diff_comm(load_comm_bytes(args.old),
                           load_comm_bytes(args.new), args.threshold)
+    fabric_rows = diff_fabric(load_fabric(args.old),
+                              load_fabric(args.new), args.threshold)
     tuned_rows = check_tuned_backend(load_tuned_stamp(args.new))
     all_regressions = (
         [r["phase"] for r in regressions]
         + [r["key"] for r in slo_rows]
         + [r["key"] for r in served_rows]
         + [r["key"] for r in comm_rows]
+        + [r["key"] for r in fabric_rows]
         + [r["key"] for r in tuned_rows]
     )
     result = {
@@ -446,6 +549,7 @@ def main(argv: list[str] | None = None) -> int:
         "slo": slo_rows,
         "served": served_rows,
         "comm": comm_rows,
+        "fabric": fabric_rows,
         "tuned_profile": tuned_rows,
         "regressions": all_regressions,
         "worst_regression": all_regressions[0] if all_regressions else None,
@@ -466,7 +570,7 @@ def main(argv: list[str] | None = None) -> int:
             mark = " <-- REGRESSED" if r["phase"] in result["regressions"] else ""
             print(f"{r['phase']:28s} {r['old_secs']:9.3f} {r['new_secs']:9.3f} "
                   f"{r['delta_secs']:+9.3f}  {rel}{mark}")
-        for r in slo_rows + served_rows + comm_rows + tuned_rows:
+        for r in slo_rows + served_rows + comm_rows + fabric_rows + tuned_rows:
             print(f"{r['key']:28s} {r['old']!s:>9s} {r['new']!s:>9s}  "
                   f"{r['why']} <-- REGRESSED")
         if all_regressions:

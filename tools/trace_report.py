@@ -297,6 +297,57 @@ def report(path: str) -> dict[str, Any]:
             },
         }
 
+    # Serving-fabric accounting (ISSUE 17): the router process publishes
+    # the fleet's lifecycle — spawns, health transitions, supervisor
+    # respawns (with measured recovery), the committed generation-floor
+    # timeline, rolling restarts, and a periodic per-replica stats fold
+    # (the replicas' own numbers, read over /status).  Rendered as the
+    # "fabric" section; tools/trace_diff.py regresses the fleet SLO
+    # record between rounds.
+    fab_events = [e for e in events
+                  if str(e.get("kind", "")).startswith("fabric_")]
+    fabric = None
+    if fab_events:
+        start = next((e for e in fab_events
+                      if e["kind"] == "fabric_start"), None)
+        stop_evt = next((e for e in reversed(fab_events)
+                         if e["kind"] == "fabric_stop"), None)
+        replica_stats: dict[Any, dict[str, Any]] = {}
+        for e in fab_events:
+            if e["kind"] == "fabric_replica_stats":
+                replica_stats[e.get("replica")] = {
+                    k: e.get(k)
+                    for k in ("requests", "executions", "replays",
+                              "p50_ms", "p99_ms", "generation", "floor")
+                }
+        for rid, st in replica_stats.items():
+            st["qps"] = (round(st["requests"] / wall, 3)
+                         if st.get("requests") and wall > 0 else None)
+        fabric = {
+            "replicas": start.get("replicas") if start else None,
+            "spawns": sum(e["kind"] == "fabric_spawn" for e in fab_events),
+            "kills": sum(e["kind"] == "fabric_kill" for e in fab_events),
+            "suspects": sum(e["kind"] == "fabric_suspect"
+                            for e in fab_events),
+            "respawns": [
+                {"replica": e.get("replica"), "pid": e.get("pid"),
+                 "recovery_s": e.get("recovery_s"),
+                 "t_rel": round(e["t"] - t0, 3)}
+                for e in fab_events if e["kind"] == "fabric_respawn"
+            ],
+            "floor_timeline": [
+                {"floor": e.get("floor"), "t_rel": round(e["t"] - t0, 3)}
+                for e in fab_events if e["kind"] == "fabric_floor"
+            ],
+            "rolls": sum(e["kind"] == "fabric_rolled" for e in fab_events),
+            "replica_stats": replica_stats,
+            "totals": (
+                {k: v for k, v in stop_evt.items()
+                 if k not in ("kind", "t", "wall", "thread", "seq")}
+                if stop_evt else None
+            ),
+        }
+
     manifest = None
     mpath = path.replace(".trace.jsonl", ".manifest.json")
     if mpath != path and os.path.exists(mpath):
@@ -315,6 +366,7 @@ def report(path: str) -> dict[str, Any]:
         ),
         "serving": serving,
         "slo": slo,
+        "fabric": fabric,
         "events": len(events),
         "bad_lines": bad,
         "complete": run_end is not None,
@@ -411,6 +463,7 @@ def stitch(root: str) -> dict[str, Any]:
             "breakdown": {k: round(v, 3) for k, v in rep["breakdown"].items()},
             "serving": rep.get("serving"),
             "slo": rep.get("slo"),
+            "fabric": rep.get("fabric"),
         })
         tree["wall_secs"] = round(tree["wall_secs"] + rep["wall_secs"], 3)
         tree["retries"] += sum(rep["retries"].values())
@@ -444,6 +497,13 @@ def render_stitched(doc: dict[str, Any]) -> str:
                     f"{sv['cache_hits']} hits, p50 "
                     f"{(sv['latency_p50_s'] or 0) * 1e3:.1f}ms p99 "
                     f"{(sv['latency_p99_s'] or 0) * 1e3:.1f}ms"
+                )
+            if ch.get("fabric"):
+                fb = ch["fabric"]
+                lines.append(
+                    f"  {'':16s} fabric: {fb.get('replicas')} replica(s), "
+                    f"{len(fb.get('respawns') or [])} respawn(s), "
+                    f"{fb.get('rolls')} rolled"
                 )
     return "\n".join(lines)
 
@@ -540,6 +600,40 @@ def render_human(rep: dict[str, Any]) -> str:
             f"{((slo.get('ingest') or {}).get('chunks'))} chunks / "
             f"{((slo.get('ingest') or {}).get('rebuilds'))} rebuilds"
         )
+    if rep.get("fabric"):
+        fb = rep["fabric"]
+        lines.append(
+            f"fabric: {fb.get('replicas')} replica(s), {fb['spawns']} "
+            f"spawn(s), {fb['kills']} kill(s), "
+            f"{len(fb['respawns'])} respawn(s), {fb['rolls']} rolled, "
+            f"{fb['suspects']} suspect transition(s)"
+        )
+        for rid in sorted(fb["replica_stats"], key=str):
+            st = fb["replica_stats"][rid]
+            lines.append(
+                f"  replica {rid}: {st.get('requests')} req "
+                f"({st.get('qps')} qps), p50 {st.get('p50_ms')}ms / "
+                f"p99 {st.get('p99_ms')}ms, {st.get('replays')} replay(s), "
+                f"gen {st.get('generation')} (floor {st.get('floor')})"
+            )
+        for r in fb["respawns"]:
+            lines.append(
+                f"  respawn: replica {r['replica']} at +{r['t_rel']}s, "
+                f"recovered in {r['recovery_s']}s"
+            )
+        if fb["floor_timeline"]:
+            lines.append("  floor timeline: " + " -> ".join(
+                f"{f['floor']}@+{f['t_rel']}s" for f in fb["floor_timeline"]
+            ))
+        if fb.get("totals"):
+            t = fb["totals"]
+            lines.append(
+                f"  totals: {t.get('requests')} routed, "
+                f"{t.get('delivered')} delivered, "
+                f"{t.get('retries', 0)} retried, "
+                f"{t.get('failed', 0)} dropped, "
+                f"{t.get('double_served', 0)} double-served"
+            )
     for key in ("retries", "chaos", "watchdog", "degraded", "exhausted",
                 "shrinks"):
         if rep.get(key):
