@@ -3,10 +3,10 @@
 Usage::
 
     python -m page_rank_and_tfidf_using_apache_spark_tpu.analysis \
-        [paths...] [--tier 1|2|3|4|5|all] [--changed-only [BASE]] [--json] \
+        [paths...] [--tier 1|2|3|4|5|6|all] [--changed-only [BASE]] [--json] \
         [--baseline FILE | --no-baseline] [--write-baseline] \
         [--cost-report] [--profile-report] [--lock-graph] [--crash-points] \
-        [--list-rules] [--list-entry-points]
+        [--wire-probes] [--list-rules] [--list-entry-points]
 
 Tier 1 is the lexical AST rule set (stdlib-only; runs even when jax is
 broken).  Tier 2 traces the registered jit entry points on the CPU backend
@@ -27,17 +27,24 @@ writer/reader schema drift against ``analysis/registry.py``
 ``ARTIFACT_SCHEMAS``, and commit-lock drift against ``COMMIT_LOCKS``;
 ``--crash-points`` prints its enumeration of every write boundary in the
 declared commit sequences (what ``tools/crash_harness.py`` replays with
-SIGKILLs).  Tiers 2 and 3 need an importable jax.  All tiers report
-through the same ratchet baseline; tier-3 advisories are printed but
-never gate.
+SIGKILLs).  Tier 6 is the distributed wire-protocol analyzer
+(stdlib-only like tiers 1/4/5): endpoint/status-code/key drift against
+``analysis/registry.py`` ``WIRE_SCHEMAS``, status-class drift against
+the router's retry logic, retry-unsafe side effects ahead of the
+request-id dedup guard, and generation-floor monotonicity;
+``--wire-probes`` prints its enumeration of the declared message space
+(what ``tools/protocol_harness.py`` replays at a live replica).  Tiers
+2 and 3 need an importable jax.  All tiers report through the same
+ratchet baseline; tier-3 advisories are printed but never gate.
 
 With no paths, tiers 1/4/5 scan the tier-1 surface (the package,
-``tools/`` and ``bench.py``) and tiers 2/3 cover every registered entry
-point.  With explicit paths (or ``--changed-only``), tier 1 scans those
-files, tiers 2/3 run only the entries whose contracted module is among
-them, and tiers 4/5 still model the whole surface but report only
-findings in those files — unless an ``analysis/`` file itself changed,
-which re-verifies every contract.
+``tools/`` and ``bench.py``), tiers 2/3 cover every registered entry
+point, and tier 6 models the declared wire surface.  With explicit
+paths (or ``--changed-only``), tier 1 scans those files, tiers 2/3 run
+only the entries whose contracted module is among them, and tiers 4/5/6
+still model the whole surface but report only findings in those files —
+unless an ``analysis/`` file itself changed, which re-verifies every
+contract.
 
 Exit codes: 0 = no findings beyond the ratchet baseline, 1 = new findings
 (printed), 2 = bad invocation.
@@ -71,13 +78,14 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="graftlint", description=__doc__)
     ap.add_argument("paths", nargs="*", type=Path,
                     help="files/dirs to scan (default: package + tools + bench.py)")
-    ap.add_argument("--tier", choices=("1", "2", "3", "4", "5", "all"),
+    ap.add_argument("--tier", choices=("1", "2", "3", "4", "5", "6", "all"),
                     default="all",
                     help="1 = lexical rules, 2 = semantic (jaxpr) checks, "
                          "3 = static cost model (intensity/pad_frac/"
                          "donation), 4 = interprocedural concurrency & "
                          "buffer-lifetime analysis, 5 = persistence & "
-                         "crash-consistency analysis, all = every tier "
+                         "crash-consistency analysis, 6 = distributed "
+                         "wire-protocol analysis, all = every tier "
                          "(default)")
     ap.add_argument("--cost-report", action="store_true",
                     help="print the tier-3 per-entry cost table as JSON "
@@ -95,6 +103,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="print the tier-5 crash-point enumeration (every "
                          "write boundary of the declared commit sequences) "
                          "as JSON; implies the tier-5 analysis ran")
+    ap.add_argument("--wire-probes", action="store_true",
+                    help="print the tier-6 message-space enumeration "
+                         "(every malformed/out-of-contract/duplicate/"
+                         "stale-floor probe the conformance harness "
+                         "replays) as JSON; implies the tier-6 analysis "
+                         "ran")
     ap.add_argument("--changed-only", nargs="?", const="HEAD", default=None,
                     metavar="BASE",
                     help="lint only files changed vs BASE (default HEAD): "
@@ -127,6 +141,9 @@ def main(argv: list[str] | None = None) -> int:
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.profile import (
             PROFILE_RULES,
         )
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis.protocol import (
+            PROTO_RULES,
+        )
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.semantic import (
             SEMANTIC_RULES,
         )
@@ -141,6 +158,8 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{rid:22s} [tier 4] {summary}")
         for rid, summary in PERSIST_RULES.items():
             print(f"{rid:22s} [tier 5] {summary}")
+        for rid, summary in PROTO_RULES.items():
+            print(f"{rid:22s} [tier 6] {summary}")
         return 0
 
     if args.list_entry_points:
@@ -164,6 +183,7 @@ def main(argv: list[str] | None = None) -> int:
         or args.profile_report
     tier4 = args.tier in ("4", "all") or args.lock_graph
     tier5 = args.tier in ("5", "all") or args.crash_points
+    tier6 = args.tier in ("6", "all") or args.wire_probes
 
     if args.changed_only is not None and args.paths:
         print("graftlint: give either paths or --changed-only, not both",
@@ -307,6 +327,28 @@ def main(argv: list[str] | None = None) -> int:
             crash_points = persistence.crash_point_report(root,
                                                           models=pmodels)
 
+    wire_probes = None
+    if tier6:
+        from page_rank_and_tfidf_using_apache_spark_tpu.analysis import (
+            protocol,
+        )
+
+        # like tiers 4/5: always model the declared wire surface; a
+        # restricted run only filters which files may report findings.
+        # One model build serves both the findings pass and the probe
+        # enumeration (the GRAFT_PROTO_BUDGET_S ci gate times this).
+        wmodels = protocol.build_models(root)
+        wres = protocol.run_protocol(root=root,
+                                     only_modules=only_modules,
+                                     models=wmodels)
+        if wres.findings:
+            findings = engine.assign_fingerprints(
+                list(findings) + wres.findings
+            )
+        if args.wire_probes:
+            wire_probes = protocol.enumerate_message_space(root,
+                                                           models=wmodels)
+
     if tier2 or tier3:
         from page_rank_and_tfidf_using_apache_spark_tpu.analysis.registry import (
             ENTRY_POINTS,
@@ -357,6 +399,11 @@ def main(argv: list[str] | None = None) -> int:
 
         print(_json.dumps(crash_points, indent=2))
 
+    if args.wire_probes and wire_probes is not None and not args.json:
+        import json as _json
+
+        print(_json.dumps(wire_probes, indent=2))
+
     if args.json:
         extra_json = {}
         if advisories:
@@ -369,6 +416,8 @@ def main(argv: list[str] | None = None) -> int:
             extra_json["lock_graph"] = lock_graph.to_json()
         if args.crash_points and crash_points is not None:
             extra_json["crash_points"] = crash_points
+        if args.wire_probes and wire_probes is not None:
+            extra_json["wire_probes"] = wire_probes
         print(
             render_json(
                 result.new,

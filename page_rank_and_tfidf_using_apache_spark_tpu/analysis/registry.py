@@ -254,6 +254,90 @@ COMMIT_LOCKS: tuple = (
 )
 
 # ---------------------------------------------------------------------------
+# Wire-protocol contract (tier 6, ISSUE 18).
+#
+# ``WIRE_SCHEMAS`` declares the router↔replica HTTP protocol the serving
+# fabric rides — every endpoint the fleet serves, in the same two-way
+# contract style as ``DONATED_CALLEES``/``ARTIFACT_SCHEMAS``: the lexical
+# surface (codes a handler returns, keys it writes, keys the router reads)
+# and this declaration may not drift apart in either direction.  A drifted
+# status code is a dropped-request class: the router's retry loop can only
+# classify what the contract names.
+#
+# Each row is ``(endpoint, method, path, handler, readers, request_keys,
+# response_keys, aux_response_keys, status_classes)``:
+#
+# - ``handler`` is a ``"<repo-relative path>::<function>[::<receiver>]"``
+#   spec; the optional receiver scopes request-key *reads* to the parsed
+#   request dict (``handle_query``'s ``req``) so a handler's other dict
+#   lookups don't pollute the request surface;
+# - ``readers`` are client-side specs (router/health-loop functions), each
+#   optionally receiver-scoped the same way for response-key reads;
+# - ``request_keys`` / ``response_keys`` are the full declared key spaces;
+# - ``aux_response_keys`` (subset of ``response_keys``) marks evidence
+#   keys written for harnesses/operators that no in-repo reader loads
+#   (the echoed ``rid`` the conformance harness byte-compares, the 503
+#   body's ``floor`` diagnostics);
+# - ``status_classes`` pairs every status code the endpoint may emit with
+#   the router-side class that handles it: ``success`` (consume),
+#   ``terminal`` (raise to the caller — never retried), ``retryable``
+#   (sibling retry under the SAME rid; 503-below-floor MUST be here), or
+#   ``suspect`` (mark the replica and reroute).
+#
+# The tier-6 checks (analysis/protocol.py) validate both directions, and
+# ``tools/protocol_harness.py`` replays the enumerated message space at a
+# live replica asserting every observed code is declared.  Parsed
+# lexically — keep it a literal.
+WIRE_SCHEMAS: tuple = (
+    ("query", "POST", "/query",
+     f"{_PKG}/serving/fabric.py::_Replica.handle_query::req",
+     (f"{_PKG}/serving/fabric.py::ServingFabric.query",),
+     ("rid", "terms", "ranker"),
+     ("rid", "replica", "generation", "scores", "docs", "error", "floor"),
+     # rid/replica/generation: harness- and operator-facing echo; floor:
+     # the 503 body's catch-up diagnostic — the router acts on the CODE
+     ("rid", "replica", "generation", "floor"),
+     ((200, "success"), (400, "terminal"), (503, "retryable"))),
+    ("status", "GET", "/status",
+     f"{_PKG}/serving/fabric.py::_Replica.handle_status",
+     (f"{_PKG}/serving/fabric.py::ServingFabric._health_loop::status",
+      f"{_PKG}/serving/fabric.py::ServingFabric.fleet_generation::s",
+      f"{_PKG}/serving/fabric.py::ServingFabric.await_fleet_generation::s",
+      f"{_PKG}/serving/fabric.py::ServingFabric.rolling_restart::s"),
+     (),
+     ("replica", "pid", "ready", "generation", "floor", "executions",
+      "replays", "p50_ms", "p99_ms", "requests", "cache_hits",
+      "refreshes"),
+     # identity + cache forensics: ops-facing, no router branch reads them
+     ("replica", "pid", "cache_hits", "refreshes"),
+     ((200, "success"),)),
+    ("healthz", "GET", "/healthz",
+     f"{_PKG}/obs/export.py::_dispatch",
+     (),
+     (), (), (),
+     ((200, "success"), (503, "retryable"))),
+    ("metrics", "GET", "/metrics",
+     f"{_PKG}/obs/export.py::_dispatch",
+     (),
+     (), (), (),
+     ((200, "success"),)),
+    ("snapshot", "GET", "/snapshot.json",
+     f"{_PKG}/obs/export.py::_dispatch",
+     (),
+     (), (), (),
+     ((200, "success"),)),
+    # the dispatcher's catch-alls: "/" is the healthz alias, 404 is the
+    # out-of-contract rejection, 500 the handler-exception backstop — the
+    # conformance harness allows exactly these beyond a row's own codes
+    ("fallback", "GET", "/",
+     f"{_PKG}/obs/export.py::_dispatch",
+     (),
+     (), (), (),
+     ((200, "success"), (404, "terminal"), (500, "suspect"),
+      (503, "retryable"))),
+)
+
+# ---------------------------------------------------------------------------
 # Autotuning search-space contract (tier 3, ISSUE 16).
 #
 # ``TUNED_KNOBS`` declares the knob space ``tools/autotune.py`` sweeps and

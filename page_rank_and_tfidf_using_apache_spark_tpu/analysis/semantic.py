@@ -75,6 +75,12 @@ SEMANTIC_RULES: dict[str, str] = {
         "a registered jit entry point no longer builds or traces — the "
         "registry contract is stale"
     ),
+    "collective-uniformity": (
+        "a collective (psum/ppermute/all_gather/...) nested under a "
+        "cond/while whose predicate depends on shard-varying operands — "
+        "shards disagree about executing the collective, which is a "
+        "deadlock on real hardware that CPU testing cannot reproduce"
+    ),
 }
 
 # Primitives that cross the host boundary from inside a compiled program.
@@ -98,6 +104,12 @@ _COMM_PRIMS = frozenset(
     }
 )
 _AXIS_PRIMS = _COMM_PRIMS | {"axis_index"}
+
+# Collectives whose OUTPUT is identical on every shard of the reduced
+# axis: a predicate derived from one of these is uniform again, so the
+# canonical `while err > tol` fixpoint (err = psum of shard residuals)
+# stays clean under the collective-uniformity check.
+_UNIFORMIZING_PRIMS = frozenset({"psum", "pmax", "pmin", "all_gather"})
 
 
 def ensure_cpu_tracing_env() -> None:
@@ -145,6 +157,151 @@ def walk_eqns(jaxpr) -> list:
             for v in eqn.params.values():
                 stack.extend(_iter_subjaxprs(v))
     return out
+
+
+def _is_literal(v) -> bool:
+    return hasattr(v, "val")  # jax.core.Literal; Vars carry no .val
+
+
+def _inner_jaxpr(value):
+    return value.jaxpr if hasattr(value, "jaxpr") else value
+
+
+def _subtree_comm_names(jaxpr) -> set[str]:
+    return {
+        e.primitive.name
+        for e in walk_eqns(jaxpr)
+        if e.primitive.name in _COMM_PRIMS
+    }
+
+
+def _propagate_varying(jaxpr, in_varying: list, in_shard: bool,
+                       record) -> list:
+    """Abstract interpretation of shard-varying-ness over ``jaxpr``.
+
+    ``in_varying`` aligns with ``jaxpr.invars`` (True = the value may
+    differ between shards).  Uniformizing collectives (psum/pmax/pmin/
+    all_gather) launder varying-ness; ppermute/all_to_all/scatter
+    variants and everything data-dependent propagate it.  Entering a
+    ``shard_map`` body seeds every invar varying and arms ``in_shard``.
+    At each ``cond``/``while`` met while armed, ``record(ctrl, comms,
+    pred_varying)`` is called with the collectives its subtree contains
+    — a varying predicate over a collective-bearing subtree is the
+    deadlock this check exists for.  Conservative on unknown structure:
+    unmatched sub-jaxpr arities degrade to any-in → all-varying, never
+    to silence."""
+    jr = _inner_jaxpr(jaxpr)
+    vmap: dict = {}
+    for v, tainted in zip(jr.invars, in_varying):
+        vmap[v] = bool(tainted)
+    for cv in jr.constvars:
+        vmap[cv] = False  # closed-over consts are replicated
+
+    def val(v) -> bool:
+        return False if _is_literal(v) else vmap.get(v, False)
+
+    for eqn in jr.eqns:
+        name = eqn.primitive.name
+        ins = [val(v) for v in eqn.invars]
+        any_in = any(ins)
+
+        if name == "shard_map":
+            inner = _inner_jaxpr(eqn.params.get("jaxpr"))
+            if inner is not None and hasattr(inner, "eqns"):
+                _propagate_varying(
+                    inner, [True] * len(inner.invars), True, record)
+            for ov in eqn.outvars:  # per-shard results: varying
+                vmap[ov] = True
+            continue
+
+        if name == "cond":
+            pred_varying = ins[0] if ins else False
+            branches = [
+                _inner_jaxpr(b) for b in eqn.params.get("branches", ())
+            ]
+            comms: set[str] = set()
+            out_any = [False] * len(eqn.outvars)
+            for b in branches:
+                comms |= _subtree_comm_names(b)
+                inner_in = ins[1:]
+                if len(b.invars) != len(inner_in):
+                    inner_in = [any_in] * len(b.invars)
+                bouts = _propagate_varying(b, inner_in, in_shard, record)
+                out_any = [
+                    a or (bouts[i] if i < len(bouts) else any_in)
+                    for i, a in enumerate(out_any)
+                ]
+            if in_shard and comms:
+                record("cond", comms, pred_varying)
+            for ov, tainted in zip(eqn.outvars, out_any):
+                vmap[ov] = tainted or pred_varying
+            continue
+
+        if name == "while":
+            cj = _inner_jaxpr(eqn.params["cond_jaxpr"])
+            bj = _inner_jaxpr(eqn.params["body_jaxpr"])
+            cn = eqn.params.get("cond_nconsts", 0)
+            bn = eqn.params.get("body_nconsts", 0)
+            cond_consts = ins[:cn]
+            body_consts = ins[cn:cn + bn]
+            carry = list(ins[cn + bn:])
+            # fixpoint: body may widen carry varying-ness across trips
+            for _ in range(len(carry) + 2):
+                bouts = _propagate_varying(
+                    bj, body_consts + carry, in_shard, lambda *a: None)
+                if len(bouts) != len(carry):
+                    bouts = [any(bouts) or any_in] * len(carry)
+                widened = [c or b for c, b in zip(carry, bouts)]
+                if widened == carry:
+                    break
+                carry = widened
+            couts = _propagate_varying(
+                cj, cond_consts + carry, in_shard, record)
+            pred_varying = any(couts)
+            comms = _subtree_comm_names(cj) | _subtree_comm_names(bj)
+            if in_shard and comms:
+                record("while", comms, pred_varying)
+            # recurse once more with the real recorder for NESTED ctrl
+            _propagate_varying(bj, body_consts + carry, in_shard, record)
+            for ov, tainted in zip(eqn.outvars, carry):
+                vmap[ov] = tainted or pred_varying
+            continue
+
+        subs = []
+        for v in eqn.params.values():
+            subs.extend(_iter_subjaxprs(v))
+        if subs:
+            souts: list = []
+            for sj in subs:
+                inner_in = (
+                    ins if len(sj.invars) == len(eqn.invars)
+                    else [any_in] * len(sj.invars)
+                )
+                souts = _propagate_varying(sj, inner_in, in_shard, record)
+            if len(subs) == 1 and len(souts) == len(eqn.outvars):
+                for ov, tainted in zip(eqn.outvars, souts):
+                    vmap[ov] = tainted
+                continue
+        out_val = False if name in _UNIFORMIZING_PRIMS else any_in
+        for ov in eqn.outvars:
+            vmap[ov] = out_val
+    return [val(v) for v in jr.outvars]
+
+
+def _divergent_collectives(closed_jaxpr) -> set:
+    """``(ctrl, comm-primitive)`` pairs for every collective nested under
+    a ``cond``/``while`` (inside a shard_map scope) whose predicate the
+    varying-ness propagation marks shard-varying."""
+    hits: set = set()
+
+    def record(ctrl: str, comms: set, pred_varying: bool) -> None:
+        if pred_varying:
+            for c in sorted(comms):
+                hits.add((ctrl, c))
+
+    jr = _inner_jaxpr(closed_jaxpr)
+    _propagate_varying(jr, [False] * len(jr.invars), False, record)
+    return hits
 
 
 def _sixty_four_bit(dtype) -> bool:
@@ -260,6 +417,7 @@ def _analyze_entry(ep: EntryPoint, root: Path) -> list[Finding]:
     worst_comms: tuple[int, str] = (0, "")
     comm_counts: dict[str, int] = {}
     undeclared_axes: set[str] = set()
+    divergent: dict[tuple, str] = {}  # (ctrl, comm) -> first variant label
     for label, args in sigs.values():
         try:
             with _x64_context():
@@ -300,6 +458,10 @@ def _analyze_entry(ep: EntryPoint, root: Path) -> list[Finding]:
         if comms > worst_comms[0]:
             worst_comms = (comms, label)
 
+        if ep.axes:  # sharded entries only: uniformity is a mesh property
+            for pair in _divergent_collectives(closed.jaxpr):
+                divergent.setdefault(pair, label)
+
     if promo:
         detail = ", ".join(f"{p}:{d}" for p, d in sorted(promo))
         add(
@@ -328,6 +490,21 @@ def _analyze_entry(ep: EntryPoint, root: Path) -> list[Finding]:
             "registry disagree about the mesh contract",
             t,
         )
+    if divergent:
+        detail = ", ".join(
+            f"{comm} under {ctrl} (variant {lbl!r})"
+            for (ctrl, comm), lbl in sorted(divergent.items())
+        )
+        add(
+            "collective-uniformity",
+            f"collective(s) nested under shard-divergent control flow: "
+            f"{detail} — shards disagree about executing the collective; "
+            "on TPU this deadlocks the mesh (JAMPI's barrier-execution "
+            "argument). Hoist the collective out of the branch/loop or "
+            "make the predicate uniform (reduce it with psum/pmax first)",
+            t,
+        )
+
     if ep.collective_budget is not None and worst_comms[0] > ep.collective_budget:
         detail = ", ".join(f"{k}×{v}" for k, v in sorted(comm_counts.items()))
         add(

@@ -368,7 +368,11 @@ class _Replica:
             rid = str(req["rid"])
             terms = [str(t) for t in req["terms"]]
             ranker = str(req.get("ranker", "tfidf"))
-        except (ValueError, KeyError, UnicodeDecodeError) as exc:
+        except (ValueError, KeyError, UnicodeDecodeError,
+                TypeError, AttributeError) as exc:
+            # TypeError/AttributeError: syntactically valid JSON of the
+            # wrong SHAPE ([], null, a bare string) — a malformed message
+            # must get a typed 400, never crash into the dispatcher's 500
             return (400, "application/json",
                     json.dumps({"error": f"bad request: {exc}"}))
         with self._lock:

@@ -47,6 +47,9 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         "GRAFT_PERSIST_BUDGET_S",  # tools/ci.sh wall-clock budget for the
         # tier-5 persistence/crash-consistency lint (read in bash;
         # default 10s)
+        "GRAFT_PROTO_BUDGET_S",  # tools/ci.sh wall-clock budget for the
+        # tier-6 wire-protocol lint AND the protocol-harness conformance
+        # smoke it derives (read in bash; default 10s)
         "GRAFT_TRACE_DIFF_THRESHOLD",  # tools/ci.sh per-phase wall-time
         # regression threshold for the trace-diff gate over the two newest
         # committed BENCH rounds (read in bash; default 0.35)

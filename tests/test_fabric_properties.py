@@ -1,0 +1,89 @@
+"""Hypothesis properties for the serving fabric's consistent-hash ring
+(ISSUE 18 satellite).
+
+``test_fabric.test_ring_remap_bound_on_replica_loss`` pins the stability
+contract for ONE fleet shape (4 replicas, kill replica 0).  These
+properties hold it universally: under arbitrary fleet add/kill
+sequences, a key whose owning replica survives the step NEVER remaps —
+removal only reshuffles the dead replica's keys, and an addition only
+moves keys onto the newcomer.  That is the invariant the router's
+affinity cache and the replica result caches ride: fleet churn must not
+invalidate survivors' working sets.
+
+Skips cleanly when hypothesis is not installed (it is optional in this
+environment), like tests/test_properties.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property-based ring tests need hypothesis",
+)
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import (  # noqa: E402
+    fabric,
+)
+
+_KEYS = [f"doc-{i:03d}" for i in range(48)]
+_SLOTS = 32
+
+
+def _owners(ring: "fabric._Ring") -> dict:
+    return {k: ring.route(k)[0] for k in _KEYS}
+
+
+@settings(max_examples=25, deadline=None)
+@given(st.sets(st.integers(0, 31), min_size=2, max_size=8), st.data())
+def test_kill_never_remaps_survivor_keys(fleet, data):
+    """For ANY fleet and ANY strict subset of kills: every key whose
+    primary owner survives keeps that owner on the shrunk ring."""
+    kill = data.draw(
+        st.sets(st.sampled_from(sorted(fleet)), max_size=len(fleet) - 1),
+        label="killed replicas",
+    )
+    survivors = fleet - kill
+    full = _owners(fabric._Ring(sorted(fleet), slots=_SLOTS))
+    shrunk = _owners(fabric._Ring(sorted(survivors), slots=_SLOTS))
+    for k in _KEYS:
+        if full[k] in survivors:
+            assert shrunk[k] == full[k], (
+                f"key {k!r} owned by surviving replica {full[k]} remapped "
+                f"to {shrunk[k]} when {sorted(kill)} died"
+            )
+
+
+@settings(max_examples=15, deadline=None)
+@given(st.lists(st.tuples(st.sampled_from(["add", "kill"]),
+                          st.integers(0, 15)),
+                max_size=12))
+def test_fleet_churn_moves_keys_only_to_the_newcomer(ops):
+    """Walk an arbitrary add/kill sequence one step at a time: after a
+    kill, every key owned by a still-present replica stays put; after an
+    add, a key either keeps its owner or moves to the replica that just
+    joined — never to an unrelated survivor."""
+    fleet = {0, 1}
+    owners = _owners(fabric._Ring(sorted(fleet), slots=_SLOTS))
+    for op, rid in ops:
+        if op == "add":
+            fleet = fleet | {rid}
+        elif len(fleet) > 1:
+            fleet = fleet - {rid} or fleet
+        new_owners = _owners(fabric._Ring(sorted(fleet), slots=_SLOTS))
+        for k in _KEYS:
+            if op == "kill":
+                if owners[k] in fleet:
+                    assert new_owners[k] == owners[k], (
+                        f"kill of {rid} remapped survivor-owned {k!r}: "
+                        f"{owners[k]} -> {new_owners[k]}"
+                    )
+            else:
+                assert new_owners[k] in (owners[k], rid), (
+                    f"add of {rid} moved {k!r} to unrelated replica "
+                    f"{new_owners[k]} (was {owners[k]})"
+                )
+        owners = new_owners

@@ -263,6 +263,136 @@ def test_sharding_axis_suppressed():
     assert "sharding-axis" not in rules_hit(run_entries(ep))
 
 
+# ----------------------------------------------- collective-uniformity
+
+
+def _shard_divergent(ctrl: str, uniform: bool):
+    """A shard_mapped program whose ``ctrl`` (cond/while) wraps a psum.
+    ``uniform=True`` reduces the predicate with a psum first (the owned
+    fixpoint idiom) — globally identical by construction; False leaves
+    it shard-varying: some shards would enter the collective, the rest
+    never arrive."""
+
+    def build():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from page_rank_and_tfidf_using_apache_spark_tpu.parallel.compat import (
+            shard_map,
+        )
+
+        mesh = Mesh(np.array(jax.devices("cpu")[:1]), ("nodes",))
+
+        def kernel(x):
+            if ctrl == "cond":
+                resid = jnp.sum(jnp.abs(x))
+                if uniform:
+                    resid = jax.lax.psum(resid, "nodes")
+                return jax.lax.cond(
+                    resid > 0.5,
+                    lambda v: jax.lax.psum(v, "nodes"),
+                    lambda v: v * 2.0,
+                    x,
+                )
+
+            def cond_fn(c):
+                resid = jnp.sum(jnp.abs(c))
+                if uniform:
+                    resid = jax.lax.psum(resid, "nodes")
+                return resid > 0.5
+
+            def body_fn(c):
+                return jax.lax.psum(c, "nodes") * 0.25
+
+            return jax.lax.while_loop(cond_fn, body_fn, x)
+
+        mapped = shard_map(
+            kernel, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False
+        )
+        return Traceable(mapped, [("v", (_sds((8,)),))])
+
+    return build
+
+
+def test_collective_uniformity_tp_cond():
+    ep = EntryPoint(
+        name="div_cond",
+        module="x.py",
+        build=_shard_divergent("cond", uniform=False),
+        axes=("nodes",),
+        collective_budget=8,
+    )
+    findings = [f for f in run_entries(ep)
+                if f.rule == "collective-uniformity"]
+    assert findings and "psum under cond" in findings[0].message
+    assert "Hoist" in findings[0].message
+
+
+def test_collective_uniformity_tp_while():
+    ep = EntryPoint(
+        name="div_while",
+        module="x.py",
+        build=_shard_divergent("while", uniform=False),
+        axes=("nodes",),
+        collective_budget=8,
+    )
+    findings = [f for f in run_entries(ep)
+                if f.rule == "collective-uniformity"]
+    assert findings and "psum under while" in findings[0].message
+
+
+def test_collective_uniformity_tn_reduced_cond_predicate():
+    """A psum-reduced predicate is uniform by construction — the branch
+    is taken identically on every shard, so the nested collective is
+    safe.  This is the owned strategies' fixpoint idiom: they pass by
+    analysis, not by exemption."""
+    ep = EntryPoint(
+        name="uni_cond",
+        module="x.py",
+        build=_shard_divergent("cond", uniform=True),
+        axes=("nodes",),
+        collective_budget=8,
+    )
+    assert "collective-uniformity" not in rules_hit(run_entries(ep))
+
+
+def test_collective_uniformity_tn_reduced_while_predicate():
+    ep = EntryPoint(
+        name="uni_while",
+        module="x.py",
+        build=_shard_divergent("while", uniform=True),
+        axes=("nodes",),
+        collective_budget=8,
+    )
+    assert "collective-uniformity" not in rules_hit(run_entries(ep))
+
+
+def test_collective_uniformity_suppressed():
+    ep = EntryPoint(
+        name="div_cond_ok",
+        module="x.py",
+        build=_shard_divergent("cond", uniform=False),
+        axes=("nodes",),
+        collective_budget=8,
+        suppress=frozenset({"collective-uniformity"}),
+    )
+    assert "collective-uniformity" not in rules_hit(run_entries(ep))
+
+
+def test_collective_uniformity_needs_declared_axes():
+    """Unsharded entries (no ``axes`` contract) never run the uniformity
+    walk — there is no mesh to diverge over."""
+    ep = EntryPoint(
+        name="unsharded",
+        module="x.py",
+        build=_shard_divergent("cond", uniform=False),
+    )
+    findings = run_entries(ep)
+    assert "collective-uniformity" not in rules_hit(findings)
+
+
 # ------------------------------------------------------- entry-point-broken
 
 

@@ -385,3 +385,108 @@ print("crash-recovery scenario: OK — "
       f"{rep['served_post']} the committed one, 0 torn, 0 orphans "
       "after recovery GC")
 EOF
+
+# ---------------------------------------------------------------------------
+# malformed-message fabric scenario (ISSUE 18): the wire-protocol harness
+# guards the router<->replica message surface; this scenario re-runs its
+# malformed / duplicate-rid / stale-floor matrix and then replays
+# malformed messages at a LIVE faulted fleet — fabric_route:net_partition@2
+# faults the router->replica link mid-retry, replica_query:proc_kill@3
+# SIGKILLs a real replica mid-query, and replica_swap:proc_kill@1 kills a
+# process at its hot-swap seam — asserting typed 400s (never a 500, never
+# a hang) and a clean dropped=0 / double_served=0 audit throughout.
+echo "== chaos: malformed messages at a faulted fleet (fabric_route / replica_query / replica_swap) =="
+python tools/protocol_harness.py
+python - <<'EOF'
+import json
+import subprocess
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path.cwd()))
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import fabric
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import segments as sgm
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    Bm25Config,
+    TfidfConfig,
+)
+
+scfg = TfidfConfig(vocab_bits=10)
+docs = ["node edge graph rank walk", "graph node directed edge weight",
+        "rank walk teleport damping node", "edge list sparse matrix graph"]
+tmp = tempfile.mkdtemp(prefix="chaos-proto-")
+out = run_tfidf(docs, scfg)
+ref = sgm.seal_segment(tmp, out, scfg, doc_base=0,
+                       ranks=np.ones(out.n_docs, np.float32),
+                       bm25=Bm25Config())
+sgm.commit_append(tmp, ref, scfg.config_hash())
+
+MALFORMED = [b"{not json", b"[]", b"null", b'{"terms": ["node"]}']
+
+
+def post_raw(port, body):
+    """None = the port is dead (a SIGKILLed replica mid-respawn: that IS
+    the chaos, not a protocol violation).  A live port must answer a
+    typed status within the timeout — never hang, never crash."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/query", data=body, method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=5.0) as r:
+            return r.status
+    except urllib.error.HTTPError as exc:
+        return exc.code
+    except urllib.error.URLError:
+        return None
+
+
+# a real 2-replica fleet: replica 1 SIGKILLs itself mid-query
+# (replica_query:proc_kill@3); the router link is partitioned every 2nd
+# hop (fabric_route:net_partition@2) while malformed bodies land at the
+# live replica ports between valid routed queries
+fab = fabric.ServingFabric(tmp, fabric.FabricConfig(
+    replicas=2, poll_s=0.1, health_period_s=0.2, retry_limit=100,
+    retry_pause_s=0.1, request_timeout_s=10.0, grace_s=10.0,
+    replica_chaos=((1, "replica_query:proc_kill@3"),),
+))
+typed_rejections = 0
+with fab:
+    with chaos.inject("fabric_route:net_partition@2"):
+        for i in range(10):
+            scores, _ = fab.query(["node"])
+            assert len(scores) > 0
+            port = fab._ports[i % len(fab._ports)]
+            code = post_raw(port, MALFORMED[i % len(MALFORMED)])
+            assert code in (400, None), (
+                f"malformed message answered {code}, want typed 400")
+            if code == 400:
+                typed_rejections += 1
+    assert typed_rejections >= 4, typed_rejections
+    audit = fab.audit()
+    assert audit["dropped"] == 0, audit
+    assert audit["double_served"] == 0, audit
+
+# the hot-swap kill seam: replica_swap:proc_kill@1 must SIGKILL the
+# process at its FIRST swap call — a malformed-timing fault the
+# supervisor absorbs in the fleet scenario above
+probe = subprocess.run(
+    [sys.executable, "-c",
+     "from page_rank_and_tfidf_using_apache_spark_tpu.resilience import "
+     "chaos\n"
+     "ctx = chaos.inject('replica_swap:proc_kill@1'); ctx.__enter__()\n"
+     "chaos.on_call('replica_swap')\n"],
+    timeout=60,
+)
+assert probe.returncode == -9, probe.returncode
+
+print("malformed-message fabric scenario: OK — typed 400s under "
+      "fabric_route:net_partition@2 + replica_query:proc_kill@3, "
+      "replica_swap:proc_kill@1 kill seam verified, "
+      "dropped=0 double_served=0")
+EOF

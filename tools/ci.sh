@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Full CI pipeline: tier-1 tests, all five graftlint tiers, and the chaos
+# Full CI pipeline: tier-1 tests, all six graftlint tiers, and the chaos
 # gate.
 #
 # The semantic lint tier (tier 2: CPU-only jaxpr tracing of every
@@ -171,6 +171,59 @@ echo "== crash-harness smoke (SIGKILL at 3 commit_append boundaries) =="
 # consistent generation — old or new, never torn — with zero orphans
 # after the recovery GC pass.  tools/chaos.sh runs the full kill matrix.
 python tools/crash_harness.py --scenarios append --max-kills 3
+
+echo "== graftlint tier 6 (wire protocol, budget ${GRAFT_PROTO_BUDGET_S:-10}s; incl. wire-probe smoke) =="
+# Distributed wire-protocol analysis (endpoint/status-code/key drift
+# against WIRE_SCHEMAS, status-class drift against the router's retry
+# logic, retry-unsafe effects ahead of the rid dedup guard, floor
+# monotonicity) is pure AST — stdlib-only like tiers 1/4/5 — under its
+# own declared budget knob.  ONE invocation serves both gates: exit
+# code = findings gate, captured stdout = the --wire-probes smoke — the
+# derived message-space enumeration must stay emittable and must still
+# contain the duplicate-rid and stale-floor probes the conformance
+# harness replays.
+t0=$(date +%s)
+wire_json=$(tools/lint.sh --tier 6 --wire-probes --json)
+dt=$(( $(date +%s) - t0 ))
+echo "protocol tier: ${dt}s"
+if [ "$dt" -gt "${GRAFT_PROTO_BUDGET_S:-10}" ]; then
+    echo "FAIL: protocol tier exceeded its ${GRAFT_PROTO_BUDGET_S:-10}s budget (${dt}s)" >&2
+    exit 1
+fi
+wire_tmp=$(mktemp)
+printf '%s\n' "$wire_json" > "$wire_tmp"
+python - "$wire_tmp" <<'EOF'
+import json
+import sys
+
+with open(sys.argv[1]) as f:
+    doc = json.load(f)
+assert doc["ok"] is True, doc.get("findings")
+probes = doc["wire_probes"]
+kinds = {p["kind"] for p in probes}
+# the two probes the harness's core invariants ride on must be derivable
+assert "duplicate-rid" in kinds, sorted(kinds)
+assert "stale-floor" in kinds, sorted(kinds)
+assert any(p["kind"] == "unknown-path" for p in probes), sorted(kinds)
+print(f"wire-probe smoke: OK ({len(probes)} probe(s), "
+      f"{len(kinds)} kind(s) enumerated)")
+EOF
+rm -f "$wire_tmp"
+
+echo "== protocol-harness smoke (declared message space at a live replica) =="
+# The dynamic half of tier 6: replay the enumerated malformed /
+# out-of-contract / duplicate-rid / stale-floor matrix at a live replica
+# and through the router — typed rejection everywhere, zero hangs, zero
+# double executions, byte-identical replay.  Shares the protocol tier's
+# budget knob: the whole matrix is a bounded smoke, not a soak.
+t0=$(date +%s)
+python tools/protocol_harness.py
+dt=$(( $(date +%s) - t0 ))
+echo "protocol harness: ${dt}s"
+if [ "$dt" -gt "${GRAFT_PROTO_BUDGET_S:-10}" ]; then
+    echo "FAIL: protocol harness exceeded its ${GRAFT_PROTO_BUDGET_S:-10}s budget (${dt}s)" >&2
+    exit 1
+fi
 
 echo "== trace-diff gate (per-phase regression across committed rounds) =="
 # Compare the two newest committed BENCH rounds: a per-phase wall-time

@@ -14,15 +14,21 @@
 # persistence & crash-consistency analyzer (tier 5: atomic-write drift,
 # pointer-flip ordering, generation-deferred GC, ARTIFACT_SCHEMAS
 # writer/reader drift, commit-lock drift — stdlib-only; --crash-points
-# prints the derived SIGKILL surface tools/crash_harness.py replays).
+# prints the derived SIGKILL surface tools/crash_harness.py replays),
+# and the distributed wire-protocol analyzer (tier 6: endpoint /
+# status-code / key drift against WIRE_SCHEMAS, status-class drift
+# against the router's retry logic, retry-unsafe effects ahead of the
+# request-id dedup guard, floor monotonicity — stdlib-only;
+# --wire-probes prints the derived message space
+# tools/protocol_harness.py replays).
 # Exit 0 = clean under the ratchet; exit 1 = new findings — fix them,
 # suppress with a justified "# graftlint: disable=<rule>" comment
-# (lexical/concurrency/persistence) or a registry-level suppress entry
-# (semantic/cost), or (outside ops//parallel/) baseline them with a
-# justification.  Pass --tier 1|2|3|4|5 to run a single tier,
-# --changed-only for the fast pre-commit path (tools/precommit.sh),
-# --cost-report for the tier-3 per-entry cost table, --lock-graph for
-# the tier-4 lock graph as DOT.
+# (lexical/concurrency/persistence/protocol) or a registry-level
+# suppress entry (semantic/cost), or (outside ops//parallel/) baseline
+# them with a justification.  Pass --tier 1|2|3|4|5|6 to run a single
+# tier, --changed-only for the fast pre-commit path
+# (tools/precommit.sh), --cost-report for the tier-3 per-entry cost
+# table, --lock-graph for the tier-4 lock graph as DOT.
 #
 # PALLAS_AXON_POOL_IPS is stripped and the CPU backend forced so the gate
 # can never hang on a wedged TPU tunnel (NOTES.md round-2 rule).

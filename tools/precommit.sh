@@ -5,10 +5,10 @@
 #
 # Tier 1 scans just the changed files; tiers 2/3 re-trace only the jit
 # entry points whose contracted module changed (all of them when analysis/
-# itself changed); tiers 4 and 5 still model the whole surface
-# (interprocedural/cross-file facts do not restrict — both models are
-# pure AST, well under a second) but report only findings in the changed
-# files.  tools/lint.sh remains the full-repo CI gate — this script is
+# itself changed); tiers 4, 5 and 6 still model the whole surface
+# (interprocedural/cross-file facts do not restrict — all three models
+# are pure AST, well under a second) but report only findings in the
+# changed files.  tools/lint.sh remains the full-repo CI gate — this script is
 # the editor-loop companion, typically <2s when nothing jit-adjacent
 # moved.
 #

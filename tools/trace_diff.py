@@ -302,6 +302,13 @@ def load_fabric(path: str) -> dict | None:
         "recovery_s": extra.get("fabric_recovery_s"),
         "dropped": extra.get("fabric_dropped"),
         "double_served": extra.get("fabric_double_served"),
+        # wire-protocol generation (ISSUE 18): the WIRE_SCHEMAS
+        # fingerprint the round's fabric numbers were measured against
+        # (absent on pre-tier-6 rounds)
+        "proto_fp": extra.get("fabric_proto_fingerprint"),
+        # host-context annotation (ISSUE 18): cpus < replicas means the
+        # n4/n1 scaling ratio measured contention, not scaling
+        "nongating": bool(extra.get("fabric_scaling_nongating")),
     }
 
 
@@ -314,7 +321,15 @@ def diff_fabric(
     dropped / double-served audit as invariants (any increase regresses).
     A round losing its fabric numbers while the old one had them is
     itself flagged; null values (failed fabric child) on either side skip
-    the comparison — the bench already recorded the failure."""
+    the comparison — the bench already recorded the failure.
+
+    Protocol-generation gate (ISSUE 18): rounds measured against
+    DIFFERENT ``WIRE_SCHEMAS`` fingerprints are not comparable — the
+    wire contract changed between them (new endpoint, different retry
+    classes), so the gate arms fresh instead of comparing.  A round
+    whose ``fabric_scaling_nongating`` annotation is set measured
+    replica contention (cpus < replicas), so scaled-fleet QPS keys skip
+    the comparison on either side — only the n1 point stays gated."""
     if old is None:
         return []
     if new is None:
@@ -325,13 +340,19 @@ def diff_fabric(
             "why": "the old round carried fleet (fabric) numbers and the "
                    "new one does not — the round lost its fabric bench",
         }]
+    o_fp, n_fp = old.get("proto_fp"), new.get("proto_fp")
+    if o_fp is not None and n_fp is not None and o_fp != n_fp:
+        return []  # wire contract changed between rounds: arm fresh
     rows: list[dict] = []
     o_qps = old.get("qps") if isinstance(old.get("qps"), dict) else {}
     n_qps = new.get("qps") if isinstance(new.get("qps"), dict) else {}
+    nongating = bool(old.get("nongating")) or bool(new.get("nongating"))
     for k in sorted(set(o_qps) & set(n_qps)):
         o, n = o_qps[k], n_qps[k]
         if o is None or n is None:
             continue
+        if nongating and k != "n1":
+            continue  # scaled-fleet point measured contention, not scaling
         if n < o * (1.0 - threshold):
             rows.append({
                 "key": f"fabric.qps.{k}",
