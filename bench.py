@@ -1022,6 +1022,47 @@ def measure_serve_fabric() -> dict:
                 "dropped": int(audit["dropped"]),
                 "double_served": int(audit["double_served"])}
 
+    def _fed_arm(index_dir: str) -> tuple:
+        """Federation + autoscale probe (ISSUE 19): a 1-replica fleet
+        with the router-side FleetHub scraping, one real scrape sweep
+        into the exact merged board, then a forced control-loop exercise
+        — a synthetic-burn tick scales 1->2 and an idle tick drains back
+        — so every round records a real spawn AND drain through the
+        autoscaler's own path, deterministically (no load-timing
+        dependence)."""
+        cfg = fb.FabricConfig(
+            replicas=1, poll_s=0.2, health_period_s=0.3,
+            retry_limit=120, retry_pause_s=0.1, grace_s=10.0,
+            latency_slo_s=0.5, availability_target=0.999,
+        )
+        with fb.ServingFabric(index_dir, cfg) as fab:
+            for q in queries[:16]:
+                fab.query(q)
+            fab.fleet.scrape_once()
+            snap = fab.fleet.snapshot()
+            scaler = fb.Autoscaler(fab, fb.AutoscaleConfig(
+                min_replicas=1, max_replicas=2, cooldown_s=0.0,
+                idle_hold_s=0.0))
+            scaler.tick({"budgets": {"availability": {"burn_rate": 10.0}}})
+            scaler.tick({})
+            stats = scaler.stats()
+            audit = fab.audit()
+        win = (snap.get("latency_s") or {}).get("window") or {}
+        flt = snap.get("fleet") or {}
+        p99 = win.get("p99")
+        fed = {
+            "replicas": len(flt.get("replicas") or []),
+            "stale": len(flt.get("stale") or []),
+            "staleness_s_max": (snap.get("gauges") or {}).get(
+                "fed_staleness_s_max"),
+            "scrapes": flt.get("scrapes"),
+            "scrape_errors": flt.get("scrape_errors"),
+            "p99_ms": None if p99 is None else round(p99 * 1e3, 3),
+        }
+        stats["scale_ups"] = int(audit.get("scale_ups", 0))
+        stats["scale_downs"] = int(audit.get("scale_downs", 0))
+        return fed, stats
+
     tmp = tempfile.mkdtemp(prefix="bench_fabric_")
     try:
         out = run_tfidf(docs, scfg)
@@ -1032,6 +1073,10 @@ def measure_serve_fabric() -> dict:
         with obs.run("serve_fabric"):
             one = _arm(tmp, 1, kill=False)
             fleet = _arm(tmp, n, kill=True)
+            try:
+                fed, scale = _fed_arm(tmp)
+            except Exception:  # noqa: BLE001 — federation probe is additive:
+                fed, scale = None, None  # null keys, fabric numbers survive
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     from page_rank_and_tfidf_using_apache_spark_tpu.analysis.protocol import (
@@ -1054,6 +1099,12 @@ def measure_serve_fabric() -> dict:
         # cpus < replicas: the fleet arms contended for the same cores,
         # so the nN/n1 ratio is context, not a gated scaling claim.
         "fabric_scaling_nongating": bool(cpus is not None and cpus < n),
+        # ISSUE 19: the fleet-federation board (replicas scraped, stale
+        # count, max staleness, fleet-aggregate p99) and the autoscaler's
+        # decision tallies from the forced scale exercise — null when the
+        # federation probe failed (the fabric numbers above survive).
+        "fleet_federation": fed,
+        "autoscale": scale,
     }
 
 
@@ -1841,6 +1892,15 @@ def _main(graph_cache: str) -> int:
             "fabric_proto_fingerprint")
         extra["fabric_scaling_nongating"] = fabric_out.get(
             "fabric_scaling_nongating")
+    # Always present (ISSUE 19 gate keys): the federation board and the
+    # autoscaler decision tallies — null = the fabric child (or its
+    # federation probe) failed this round; trace_diff's flap-count and
+    # fleet-p99 gates skip nulls but flag a round that LOST the keys.
+    extra["fleet_federation"] = None
+    extra["autoscale"] = None
+    if fabric_out:
+        extra["fleet_federation"] = fabric_out.get("fleet_federation")
+        extra["autoscale"] = fabric_out.get("autoscale")
     # Always present so rounds are comparable: null = the sharded child
     # did not produce a number this round.
     extra["tfidf_sharded_tokens_per_sec"] = None

@@ -326,6 +326,22 @@ WIRE_SCHEMAS: tuple = (
      (),
      (), (), (),
      ((200, "success"),)),
+    # router-side fleet endpoints (ISSUE 19): the router's own exporter
+    # serves the SAME obs/export.py dispatcher over the FleetHub, so the
+    # merged fleet snapshot/metrics reuse the dispatcher's declared code
+    # surface; the scrape path is in-contract via its declared reader —
+    # FleetHub's fetch consumes a replica's /snapshot.json (whose
+    # "mergeable" payload is opaque raw hub state, not wire keys)
+    ("fleet_snapshot", "GET", "/snapshot.json",
+     f"{_PKG}/obs/export.py::_dispatch",
+     (f"{_PKG}/obs/federation.py::FleetHub._http_fetch",),
+     (), (), (),
+     ((200, "success"),)),
+    ("fleet_metrics", "GET", "/metrics",
+     f"{_PKG}/obs/export.py::_dispatch",
+     (),
+     (), (), (),
+     ((200, "success"),)),
     # the dispatcher's catch-alls: "/" is the healthz alias, 404 is the
     # out-of-contract rejection, 500 the handler-exception backstop — the
     # conformance harness allows exactly these beyond a row's own codes
@@ -335,6 +351,118 @@ WIRE_SCHEMAS: tuple = (
      (), (), (),
      ((200, "success"), (404, "terminal"), (500, "suspect"),
       (503, "retryable"))),
+)
+
+# ---------------------------------------------------------------------------
+# Metric-name contract (tier 2, ISSUE 19).
+#
+# ``METRIC_SCHEMAS`` declares every metric name the repo publishes — the
+# run-aggregate namespace (``obs.counter/gauge/histogram``, folded into the
+# run summary and trace) and the live-SLO namespace (``MetricsHub``
+# counters/gauges/budgets, exported over ``/snapshot.json``/``/metrics``
+# and federated across the fleet).  A renamed metric silently breaks every
+# downstream reader — dashboards, ``tools/slo_watch.py``, ``trace_diff``
+# gates, the federation merge — so the name space is a declared contract,
+# not a convention.
+#
+# Each row is ``(name, kind, unit, sites)``:
+#
+# - ``name`` may contain ``*`` for template-published families
+#   (``fabric_replica*_requests`` is an f-string gauge per replica id);
+# - ``kind`` is ``counter`` / ``gauge`` / ``histogram`` / ``slo`` (error
+#   budgets; fed by ``observe_request``, not a named publish call);
+# - ``unit`` is documentation for operators (board column headers);
+# - ``sites`` are the repo-relative modules that publish the name.
+#
+# The ``metric-name-drift`` check (analysis/rules.py) validates both
+# directions: every literal publish call in the package must be covered by
+# a row (name AND publishing module), and every row's name must appear in
+# every site it claims.  Parsed lexically — keep it a literal.
+METRIC_SCHEMAS: tuple = (
+    # ---- run-aggregate namespace (obs.counter/gauge/histogram)
+    ("degraded", "counter", "count",
+     (f"{_PKG}/dataflow/fixpoint.py", f"{_PKG}/models/tfidf.py",
+      f"{_PKG}/resilience/elastic.py", f"{_PKG}/resilience/executor.py",
+      f"{_PKG}/resilience/process.py", f"{_PKG}/obs/metrics.py")),
+    ("*.segment_secs", "histogram", "seconds",
+     (f"{_PKG}/dataflow/fixpoint.py",)),
+    ("h2d_overlap_frac", "gauge", "fraction",
+     (f"{_PKG}/dataflow/ingest.py", f"{_PKG}/obs/metrics.py")),
+    ("tfidf.chunks", "counter", "count", (f"{_PKG}/models/tfidf.py",)),
+    ("tfidf.chunk_secs", "histogram", "seconds",
+     (f"{_PKG}/models/tfidf.py",)),
+    ("pagerank.comm_bytes_per_step", "gauge", "bytes",
+     (f"{_PKG}/parallel/pagerank_sharded.py",)),
+    ("chaos_injections", "counter", "count",
+     (f"{_PKG}/resilience/chaos.py",)),
+    ("watchdog_fires", "counter", "count",
+     (f"{_PKG}/resilience/executor.py",)),
+    ("retries", "counter", "count", (f"{_PKG}/resilience/executor.py",)),
+    ("backoff_secs", "histogram", "seconds",
+     (f"{_PKG}/resilience/executor.py",)),
+    ("exhausted", "counter", "count",
+     (f"{_PKG}/resilience/executor.py", f"{_PKG}/obs/metrics.py")),
+    ("respawns", "counter", "count", (f"{_PKG}/resilience/process.py",)),
+    ("fabric_replica*_requests", "gauge", "requests",
+     (f"{_PKG}/serving/fabric.py",)),
+    ("segment_commits", "counter", "count",
+     (f"{_PKG}/serving/segments.py",)),
+    ("segment_orphan_gcs", "counter", "count",
+     (f"{_PKG}/serving/segments.py",)),
+    ("segment_merges", "counter", "count",
+     (f"{_PKG}/serving/segments.py",)),
+    ("segment_merge_failures", "counter", "count",
+     (f"{_PKG}/serving/segments.py",)),
+    ("serve.cache_misses", "counter", "count",
+     (f"{_PKG}/serving/server.py",)),
+    ("serve.cache_hits", "counter", "count",
+     (f"{_PKG}/serving/server.py",)),
+    ("serve.batch_errors", "counter", "count",
+     (f"{_PKG}/serving/server.py",)),
+    ("serve.query_truncated", "counter", "count",
+     (f"{_PKG}/serving/server.py",)),
+    ("serve.latency_s", "histogram", "seconds",
+     (f"{_PKG}/serving/server.py",)),
+    ("serve.queue_wait_s", "histogram", "seconds",
+     (f"{_PKG}/serving/server.py",)),
+    ("checkpoint_saves", "counter", "count",
+     (f"{_PKG}/utils/checkpoint.py",)),
+    # bench parent's per-label sharded-PageRank comm-volume gauge
+    ("owned_scale.comm_bytes.*", "gauge", "bytes", ("bench.py",)),
+    ("artifact_saves", "counter", "count",
+     (f"{_PKG}/utils/checkpoint.py",)),
+    # ---- live-SLO namespace (MetricsHub; federated exactly, ISSUE 19)
+    ("serve.requests", "counter", "requests", (f"{_PKG}/obs/metrics.py",)),
+    ("serve.ok", "counter", "requests", (f"{_PKG}/obs/metrics.py",)),
+    ("serve.errors", "counter", "requests", (f"{_PKG}/obs/metrics.py",)),
+    ("chaos.injections", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("chaos.losses", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    # event-kind passthrough counters (ingest_event's kind sets): the
+    # publish call is `self.count(kind)`, so the names live in the kind
+    # tuples, not in call literals
+    ("retry", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("backoff", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("watchdog", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("checkpoint_save", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("serve_start", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("soak_rebuild", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("soak_swap", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("soak_loss_injected", "counter", "count",
+     (f"{_PKG}/obs/metrics.py",)),
+    ("soak_recovered", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("soak_prior_refresh", "counter", "count",
+     (f"{_PKG}/obs/metrics.py",)),
+    ("ingest.chunks", "counter", "count", (f"{_PKG}/obs/metrics.py",)),
+    ("ingest.tokens", "counter", "tokens", (f"{_PKG}/obs/metrics.py",)),
+    # fleet-federation gauges (router-side FleetHub, ISSUE 19)
+    ("fed_replicas", "gauge", "count", (f"{_PKG}/obs/federation.py",)),
+    ("fed_stale_replicas", "gauge", "count",
+     (f"{_PKG}/obs/federation.py",)),
+    ("fed_staleness_s_max", "gauge", "seconds",
+     (f"{_PKG}/obs/federation.py",)),
+    # error budgets (MetricsHub.budgets keys; ErrorBudget instruments)
+    ("availability", "slo", "fraction", (f"{_PKG}/obs/metrics.py",)),
+    ("latency", "slo", "fraction", (f"{_PKG}/obs/metrics.py",)),
 )
 
 # ---------------------------------------------------------------------------

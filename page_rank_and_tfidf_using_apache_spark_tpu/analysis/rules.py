@@ -1217,6 +1217,215 @@ def check_env_knob_drift(ctx: FileContext) -> Iterator[Hit]:
 
 
 # --------------------------------------------------------------------------
+# 9b. metric-name-drift
+# --------------------------------------------------------------------------
+
+# The declared metric-name contract (analysis/registry.py METRIC_SCHEMAS):
+# rows of (name glob, kind, unit, publishing sites).  Two namespaces share
+# it — run-aggregate publishes (``obs.counter/gauge/histogram``) and live-
+# SLO hub publishes (``hub.count/counter/gauge``, plus MetricsHub's own
+# ``self.*`` calls) — because both end up in operator-facing surfaces
+# (run summary / trace_report on one side, /metrics / slo_watch /
+# federation on the other) where a silent rename breaks every reader.
+
+_metric_schema_cache: dict = {}
+
+_METRIC_KINDS = frozenset({"counter", "gauge", "histogram", "slo"})
+_METRIC_CALL_KIND = {
+    "count": "counter",
+    "counter": "counter",
+    "gauge": "gauge",
+    "histogram": "histogram",
+}
+_METRIC_RECEIVERS = frozenset({"obs", "hub"})
+
+
+def _parse_metric_rows(reg_path) -> "tuple | None":
+    """Lexically extract METRIC_SCHEMAS rows from analysis/registry.py,
+    resolving the registry's ``f"{_PKG}/..."`` site paths through its
+    module-level string constants (never imports — the linter must run
+    even when the package is broken).  None when the file has no
+    declaration."""
+    try:
+        tree = ast.parse(reg_path.read_text(encoding="utf-8"))
+    except (OSError, SyntaxError):
+        return None
+    consts: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            consts[node.targets[0].id] = node.value.value
+
+    def lit(elt: ast.AST) -> str | None:
+        if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+            return elt.value
+        if isinstance(elt, ast.JoinedStr):
+            parts = []
+            for v in elt.values:
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    parts.append(v.value)
+                elif isinstance(v, ast.FormattedValue) and \
+                        isinstance(v.value, ast.Name) and \
+                        v.value.id in consts:
+                    parts.append(consts[v.value.id])
+                else:
+                    return None
+            return "".join(parts)
+        return None
+
+    for node in ast.walk(tree):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "METRIC_SCHEMAS"
+                   for t in targets):
+            continue
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            return None
+        rows = []
+        for row in value.elts:
+            if not isinstance(row, (ast.Tuple, ast.List)) or \
+                    len(row.elts) != 4:
+                continue
+            name, kind, unit = (lit(e) for e in row.elts[:3])
+            sites_elt = row.elts[3]
+            sites = tuple(
+                s for s in (lit(e) for e in sites_elt.elts) if s
+            ) if isinstance(sites_elt, (ast.Tuple, ast.List)) else ()
+            if name and kind:
+                rows.append((name, kind, unit or "", sites))
+        return tuple(rows)
+    return None
+
+
+def metric_schema_rows(root) -> "tuple | None":
+    """METRIC_SCHEMAS rows for the scanned tree (falling back to this
+    package's own analysis/registry.py for bare snippet lints); cached
+    per root.  Each row is ``(name_glob, kind, unit, sites)``."""
+    from pathlib import Path
+
+    key = str(root) if root is not None else ""
+    if key in _metric_schema_cache:
+        return _metric_schema_cache[key]
+    candidates = []
+    if root is not None:
+        candidates += [
+            Path(root) / "page_rank_and_tfidf_using_apache_spark_tpu/analysis/registry.py",
+            Path(root) / "analysis/registry.py",
+        ]
+    candidates.append(Path(__file__).resolve().parent / "registry.py")
+    rows = None
+    for c in candidates:
+        if c.exists():
+            rows = _parse_metric_rows(c)
+            if rows is not None:
+                break
+    _metric_schema_cache[key] = rows
+    return rows
+
+
+@rule(
+    "metric-name-drift",
+    "a metric published under a name not declared in analysis/registry.py "
+    "METRIC_SCHEMAS (or from a module the row does not list, or with a "
+    "kind the row contradicts), or a declared metric no site publishes — "
+    "every dashboard, slo_watch board, trace_diff gate and federation "
+    "merge keys on these names, so the name space is a checked contract",
+)
+def check_metric_name_drift(ctx: FileContext) -> Iterator[Hit]:
+    rows = metric_schema_rows(ctx.root)
+    if ctx.relpath.endswith("analysis/registry.py"):
+        # declaration side: every row's kind must be known and its name's
+        # literal fragments must appear in every site it claims (glob
+        # names check their non-* fragments, the f-string publish pattern)
+        if rows is None or ctx.root is None:
+            return
+        for name, kind, _unit, sites in rows:
+            if kind not in _METRIC_KINDS:
+                yield (
+                    ctx.tree,
+                    f"METRIC_SCHEMAS row {name!r} declares unknown kind "
+                    f"{kind!r} (expected one of {sorted(_METRIC_KINDS)})",
+                )
+            frags = [f for f in name.split("*") if f]
+            for site in sites:
+                path = ctx.root / site
+                try:
+                    text = path.read_text(encoding="utf-8") \
+                        if path.exists() else None
+                except OSError:
+                    text = None
+                if text is None or not all(f in text for f in frags):
+                    yield (
+                        ctx.tree,
+                        f"METRIC_SCHEMAS declares {name!r} published from "
+                        f"{site} but the name appears nowhere there — "
+                        "stale registry row or renamed metric",
+                    )
+        return
+
+    # usage side: every literal-named publish call must be covered by a
+    # row — name, kind and publishing module.  Variable names (e.g.
+    # ingest_event's `self.count(kind)` passthrough) are skipped; their
+    # kind-set literals are validated by the declaration side above.
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)):
+            continue
+        kind = _METRIC_CALL_KIND.get(fn.attr)
+        if kind is None:
+            continue
+        recv = fn.value.id
+        if recv not in _METRIC_RECEIVERS and not (
+                recv == "self" and ctx.relpath.endswith("obs/metrics.py")):
+            continue
+        resolved = resolve_thread_name(ctx, node.args[0], node)
+        if resolved is None:
+            continue
+        if rows is None:
+            yield (
+                node,
+                f"metric {resolved!r} published but no METRIC_SCHEMAS "
+                "declaration found — declare the metric-name contract in "
+                "analysis/registry.py",
+            )
+            continue
+        matched = [r for r in rows if _names_match(resolved, r[0])]
+        if not matched:
+            yield (
+                node,
+                f"metric {resolved!r} is not declared in "
+                "analysis/registry.py METRIC_SCHEMAS — register (name, "
+                "kind, unit, publishing sites) before publishing it",
+            )
+            continue
+        kinded = [r for r in matched if r[1] == kind]
+        if not kinded:
+            yield (
+                node,
+                f"metric {resolved!r} is published as a {kind} but "
+                f"METRIC_SCHEMAS declares it {matched[0][1]!r} — a kind "
+                "change breaks every reader's aggregation; fix one side",
+            )
+        elif not any(ctx.relpath == s for r in kinded for s in r[3]):
+            yield (
+                node,
+                f"metric {resolved!r} is published from {ctx.relpath!r} "
+                "which its METRIC_SCHEMAS row does not list — add the "
+                "site or move the publish",
+            )
+
+
+# --------------------------------------------------------------------------
 # 10. ladder-rung-drift
 # --------------------------------------------------------------------------
 

@@ -58,7 +58,11 @@ from page_rank_and_tfidf_using_apache_spark_tpu.obs.trace import SpanTracer
 # (obs.export).  Imported after runtime so their obs-package imports see
 # a fully-initialized module.
 from page_rank_and_tfidf_using_apache_spark_tpu.obs import export  # noqa: E402
+from page_rank_and_tfidf_using_apache_spark_tpu.obs import federation  # noqa: E402
 from page_rank_and_tfidf_using_apache_spark_tpu.obs import metrics  # noqa: E402
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.federation import (  # noqa: E402
+    FleetHub,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.obs.metrics import (  # noqa: E402
     ErrorBudget,
     MetricsHub,
@@ -72,6 +76,7 @@ __all__ = [
     "Aggregates",
     "ErrorBudget",
     "EventBus",
+    "FleetHub",
     "JsonlSink",
     "MemorySink",
     "MetricsHub",
@@ -82,6 +87,7 @@ __all__ = [
     "TelemetrySink",
     "WindowedCounter",
     "export",
+    "federation",
     "metrics",
     "bus",
     "counter",

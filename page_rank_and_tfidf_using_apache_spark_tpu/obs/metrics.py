@@ -23,6 +23,18 @@ only reconstructs them after death.  Everything here is:
 The pull side lives in :mod:`obs.export` (HTTP snapshot endpoint) and
 ``tools/slo_watch.py`` (terminal renderer); the soak harness
 (:mod:`serving.soak`) scores its SLOs from a hub snapshot.
+
+**Exact cross-process merge (ISSUE 19).**  Every instrument shares one
+geometric bin layout, so fleet federation is *exact arithmetic*, not
+estimation: ``to_mergeable()`` exports the raw state (bin counts, sums,
+min/max, window tallies — JSON-safe, inf-free) and ``merge()`` folds a
+peer's mergeable in.  Counts, sums and min/max merge byte-exactly;
+quantiles read off the merged bins keep the same one-bin tolerance a
+single process has (relative error <= ``growth - 1``).  The contract
+that makes this sound — every process agreeing on metric names and bin
+layout — is declared in ``analysis/registry.METRIC_SCHEMAS`` and
+machine-checked by the ``metric-name-drift`` lint; a mismatched layout
+raises at merge time rather than silently skewing fleet percentiles.
 """
 
 from __future__ import annotations
@@ -117,6 +129,25 @@ class HistogramBins:
 LATENCY_BINS = dict(lo=1e-6, hi=1e3, growth=1.1)
 
 
+def _bins_sig(bins: HistogramBins) -> dict[str, float]:
+    return {"lo": bins.lo, "hi": bins.hi, "growth": bins.growth}
+
+
+def _require_same_bins(bins: HistogramBins, sig: dict[str, Any],
+                       what: str) -> None:
+    """Merge precondition: identical bin layout on both sides.  A layout
+    mismatch is a fleet-config bug (metric-name-drift territory), and
+    folding counts across different edges would silently corrupt every
+    quantile — so it raises instead."""
+    theirs = (float(sig["lo"]), float(sig["hi"]), float(sig["growth"]))
+    ours = (bins.lo, bins.hi, bins.growth)
+    if theirs != ours:
+        raise ValueError(
+            f"{what}.merge: bin layout mismatch "
+            f"(ours lo/hi/growth={ours}, theirs={theirs})"
+        )
+
+
 class StreamingHistogram:
     """Cumulative fixed-bin histogram with online quantiles.
 
@@ -174,6 +205,43 @@ class StreamingHistogram:
         """Telemetry-state footprint — constant in the event count (the
         10^6-event regression test pins this)."""
         return int(self._counts.nbytes) + 64
+
+    def to_mergeable(self) -> dict[str, Any]:
+        """Raw exportable state: bin layout + counts + exact
+        count/sum/min/max.  JSON-safe — min/max are None until the first
+        observation (never ±inf on the wire)."""
+        with self._lock:
+            return {
+                "kind": "streaming_histogram",
+                "bins": _bins_sig(self.bins),
+                "counts": self._counts.tolist(),
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min if self._count else None,
+                "max": self._max if self._count else None,
+            }
+
+    def merge(self, m: dict[str, Any]) -> None:
+        """Fold a peer's :meth:`to_mergeable` in.  Count/sum/min/max and
+        the bin counts merge exactly; quantiles of the merged state keep
+        the one-bin tolerance.  One-shot: merging the same export twice
+        double-counts (the federation layer re-merges *fresh* scrapes
+        into a fresh hub instead)."""
+        _require_same_bins(self.bins, m["bins"], "StreamingHistogram")
+        add = np.asarray(m["counts"], np.int64)
+        if add.shape != self._counts.shape:
+            raise ValueError(
+                f"StreamingHistogram.merge: {add.shape} vs "
+                f"{self._counts.shape} slots"
+            )
+        with self._lock:
+            self._counts += add
+            self._count += int(m["count"])
+            self._sum += float(m["sum"])
+            if m.get("min") is not None:
+                self._min = min(self._min, float(m["min"]))
+            if m.get("max") is not None:
+                self._max = max(self._max, float(m["max"]))
 
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
@@ -254,6 +322,49 @@ class RollingHistogram:
         with self._lock:
             return int(self._merged_locked().sum())
 
+    def to_mergeable(self) -> dict[str, Any]:
+        """Exportable live window: the ring's row ids are keyed to this
+        process's own monotonic clock, so raw rows do not transport —
+        what crosses the wire is the *merged current window* plus the
+        lifetime extremes."""
+        with self._lock:
+            merged = self._merged_locked()
+            vmin, vmax = self._min, self._max
+        return {
+            "kind": "rolling_histogram",
+            "bins": _bins_sig(self.bins),
+            "window_s": self.window_s,
+            "window_counts": merged.tolist(),
+            "min": None if vmin == math.inf else vmin,
+            "max": None if vmax == -math.inf else vmax,
+        }
+
+    def merge(self, m: dict[str, Any]) -> None:
+        """Fold a peer's exported window into the slot owning *now*: the
+        peer's last-window traffic lands at merge time, so a window read
+        shortly after covers the union of both fleets' recent traffic
+        (counts exact, quantiles within one bin).  One-shot — see
+        :meth:`StreamingHistogram.merge`."""
+        _require_same_bins(self.bins, m["bins"], "RollingHistogram")
+        if float(m["window_s"]) != self.window_s:
+            raise ValueError(
+                f"RollingHistogram.merge: window_s mismatch "
+                f"({m['window_s']} vs {self.window_s})"
+            )
+        add = np.asarray(m["window_counts"], np.int64)
+        if add.shape != (self.bins.n_slots,):
+            raise ValueError(
+                f"RollingHistogram.merge: {add.shape} vs "
+                f"({self.bins.n_slots},) slots"
+            )
+        now = self._clock()
+        with self._lock:
+            if m.get("min") is not None:
+                self._min = min(self._min, float(m["min"]))
+            if m.get("max") is not None:
+                self._max = max(self._max, float(m["max"]))
+            self._row_for(int(now / self.slot_s))[:] += add
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             merged = self._merged_locked()
@@ -325,6 +436,49 @@ class WindowedCounter:
     def snapshot(self) -> dict[str, Any]:
         return {"total": self.total(), "rate_per_s": round(self.rate(), 4)}
 
+    def to_mergeable(self) -> dict[str, Any]:
+        """Exportable state: exact cumulative total, the live window sum,
+        and how many seconds of window the counter has actually covered
+        (so a merged rate divides by real coverage, not assumed age)."""
+        now = self._clock()
+        with self._lock:
+            covered = (0.0 if self._t0 is None
+                       else max(min(now - self._t0, self.window_s),
+                                self.slot_s))
+            return {
+                "kind": "windowed_counter",
+                "window_s": self.window_s,
+                "total": self._total,
+                "window_sum": self._window_sum_locked(),
+                "covered_s": covered,
+            }
+
+    def merge(self, m: dict[str, Any]) -> None:
+        """Fold a peer's export in: totals add exactly; the peer's window
+        sum lands in the slot owning *now*; coverage extends ``_t0`` so
+        the merged rate is over the widest window either side covered.
+        One-shot — see :meth:`StreamingHistogram.merge`."""
+        if float(m["window_s"]) != self.window_s:
+            raise ValueError(
+                f"WindowedCounter.merge: window_s mismatch "
+                f"({m['window_s']} vs {self.window_s})"
+            )
+        now = self._clock()
+        with self._lock:
+            self._total += float(m["total"])
+            covered = float(m.get("covered_s") or 0.0)
+            if covered > 0.0:
+                t0 = now - covered
+                self._t0 = t0 if self._t0 is None else min(self._t0, t0)
+            w = float(m["window_sum"])
+            if w:
+                slot_no = int(now / self.slot_s)
+                i = slot_no % self.slots
+                if self._slot_ids[i] != slot_no:
+                    self._sums[i] = 0.0
+                    self._slot_ids[i] = slot_no
+                self._sums[i] += w
+
 
 class ErrorBudget:
     """SLO target + error-budget accounting over a sliding window.
@@ -371,6 +525,26 @@ class ErrorBudget:
             "window_bad": int(w_bad),
             "burn_rate": round(min(burn, 1e9), 4),
         }
+
+    def to_mergeable(self) -> dict[str, Any]:
+        return {
+            "kind": "error_budget",
+            "target": self.target,
+            "all": self._all.to_mergeable(),
+            "bad": self._bad.to_mergeable(),
+        }
+
+    def merge(self, m: dict[str, Any]) -> None:
+        """Fold a peer's budget in.  Targets must agree — a fleet whose
+        replicas promise different SLOs has no single budget to burn
+        (and METRIC_SCHEMAS pins the fleet-wide target names)."""
+        if float(m["target"]) != self.target:
+            raise ValueError(
+                f"ErrorBudget.merge: target mismatch "
+                f"({m['target']} vs {self.target})"
+            )
+        self._all.merge(m["all"])
+        self._bad.merge(m["bad"])
 
 
 class MetricsHub:
@@ -480,6 +654,50 @@ class MetricsHub:
                       "soak_prior_refresh"):
             self.count(kind)
 
+    # ------------------------------------------------------- federation
+
+    def to_mergeable(self) -> dict[str, Any]:
+        """The hub's full raw state for exact cross-process federation —
+        embedded in every :meth:`snapshot` so any process's
+        ``/snapshot.json`` is federable with no extra endpoint."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+        budgets = dict(self.budgets)
+        return {
+            "window_s": self.window_s,
+            "latency": self.latency.to_mergeable(),
+            "latency_total": self.latency_total.to_mergeable(),
+            "queue_wait": self.queue_wait.to_mergeable(),
+            "counters": {k: c.to_mergeable()
+                         for k, c in sorted(counters.items())},
+            "gauges": gauges,
+            "budgets": {k: b.to_mergeable()
+                        for k, b in sorted(budgets.items())},
+        }
+
+    def merge_mergeable(self, m: dict[str, Any]) -> None:
+        """Fold one process's :meth:`to_mergeable` export into this hub:
+        histograms/counters/budgets merge exactly (missing counters and
+        budgets are created on first sight); gauges are last-write-wins —
+        the federation layer exports per-replica gauges under replica
+        labels instead of pretending point-in-time values add."""
+        self.latency.merge(m["latency"])
+        self.latency_total.merge(m["latency_total"])
+        self.queue_wait.merge(m["queue_wait"])
+        for name, cm in m.get("counters", {}).items():
+            self.counter(name).merge(cm)
+        for name, v in m.get("gauges", {}).items():
+            self.gauge(name, v)
+        for name, bm in m.get("budgets", {}).items():
+            with self._lock:
+                b = self.budgets.get(name)
+                if b is None:
+                    b = self.budgets[name] = ErrorBudget(
+                        float(bm["target"]), window_s=self.window_s,
+                        slots=self._slots, clock=self._clock)
+            b.merge(bm)
+
     # ------------------------------------------------------------ rendering
 
     def snapshot(self) -> dict[str, Any]:
@@ -497,6 +715,7 @@ class MetricsHub:
             "counters": {k: c.snapshot() for k, c in sorted(counters.items())},
             "gauges": gauges,
             "budgets": {k: b.snapshot() for k, b in sorted(self.budgets.items())},
+            "mergeable": self.to_mergeable(),
         }
 
     def prometheus(self) -> str:

@@ -31,6 +31,27 @@ segment files — so this module adds only the coordination:
   wait for the fleet to reach generation G, commit the floor at G, then
   TERM→respawn one replica at a time while siblings keep serving.
 
+ISSUE 19 grows the fleet a shared observability plane and closes the
+ROADMAP's autoscaling follow-on on it:
+
+- **Fleet federation**: the router owns a :class:`obs.federation.FleetHub`
+  that scrapes every replica's ``/snapshot.json`` (guarded ``fed_scrape``
+  site, staleness-labeled, never routing-blocking) and serves the exact
+  fleet merge from the ROUTER's own ``/snapshot.json`` + ``/metrics``.
+- **Membership is dynamic**: replicas live in id-keyed maps and the hash
+  ring is rebuilt on membership change — :meth:`ServingFabric.scale_up`
+  spawns a NEW id (survivor-owned keys never remap), and
+  :meth:`ServingFabric.scale_down` drains the newest id (out of the ring
+  first, then SIGTERM; in-flight queries finish or re-dispatch typed).
+- **Autoscaler**: a control loop that reads ONLY the fleet hub —
+  availability/latency burn rate and queue-wait p99 scale up, sustained
+  idle scales down — bounded by min/max, rate-limited by a cooldown, and
+  hysteretic (the scale-down thresholds sit far below the scale-up ones,
+  so one noisy window cannot flap the fleet).  Every decision is
+  published as an ``autoscale`` event carrying its measured inputs;
+  ``tools/trace_report.py`` renders the timeline and ``tools/trace_diff.py``
+  gates on flap count.
+
 Process-level chaos rides the deterministic ``GRAFT_CHAOS`` grammar:
 ``replica_query:proc_kill@N`` SIGKILLs a replica mid-query (injected in
 THAT replica's environment via ``FabricConfig.replica_chaos``),
@@ -63,6 +84,11 @@ from typing import Any, Sequence
 import numpy as np
 
 from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.federation import FleetHub
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.metrics import (
+    MetricsHub,
+    TelemetrySink,
+)
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
 from page_rank_and_tfidf_using_apache_spark_tpu.resilience import (
     executor as rx,
@@ -150,6 +176,11 @@ class FabricConfig:
     replica_chaos: tuple = ()  # ((replica_idx, GRAFT_CHAOS spec), ...):
     # targeted replica-side injection — the spec lands in THAT replica's
     # environment only, so a proc_kill schedule is per-process-deterministic
+    federation: bool = True  # router-side FleetHub + fleet exporter
+    fleet_window_s: float = 60.0  # fleet hub window (MUST match the
+    # replicas' default hub window — merge raises on mismatch)
+    latency_slo_s: float | None = None  # fleet latency budget (None: off)
+    availability_target: float | None = None  # fleet availability budget
 
     @staticmethod
     def from_env(**overrides) -> "FabricConfig":
@@ -158,6 +189,41 @@ class FabricConfig:
             if raw:
                 overrides["replicas"] = int(raw)
         return FabricConfig(**overrides)
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Autoscaler policy: bounds, cadence, and the up/down thresholds.
+
+    Hysteresis is structural: scaling UP needs acute pressure (budget
+    burn >= ``burn_up`` — budget consumed at twice the sustainable rate —
+    or queue-wait p99 over ``queue_p99_up_s``), while scaling DOWN needs
+    the opposite extreme *sustained* (offered rate under
+    ``idle_rate_down`` AND burn under ``burn_down`` for ``idle_hold_s``
+    straight).  The dead band between them plus the cooldown is what the
+    flap-count gate in tools/trace_diff.py relies on."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    cooldown_s: float = 10.0  # min seconds between scale actions
+    period_s: float = 1.0  # control-loop evaluation cadence
+    burn_up: float = 2.0  # any budget burning >= 2x its rate: scale up
+    queue_p99_up_s: float = 0.5  # queue-wait p99 over this: scale up
+    burn_down: float = 0.5  # burn must be under this to call the fleet idle
+    idle_rate_down: float = 0.5  # req/s under this counts as idle
+    idle_hold_s: float = 5.0  # idle must hold this long before scale-down
+
+    @staticmethod
+    def from_env(**overrides) -> "AutoscaleConfig":
+        env = {
+            "min_replicas": os.environ.get("GRAFT_AUTOSCALE_MIN"),
+            "max_replicas": os.environ.get("GRAFT_AUTOSCALE_MAX"),
+            "cooldown_s": os.environ.get("GRAFT_AUTOSCALE_COOLDOWN_S"),
+        }
+        for key, raw in env.items():
+            if raw and key not in overrides:
+                overrides[key] = float(raw) if key.endswith("_s") else int(raw)
+        return AutoscaleConfig(**overrides)
 
 
 # --------------------------------------------------------------- ring
@@ -457,6 +523,9 @@ def replica_main(argv: "list[str] | None" = None) -> int:
     p.add_argument("--max-batch", type=int, default=None)
     p.add_argument("--scoring", choices=["coo", "impacted"], default="coo")
     p.add_argument("--poll-s", type=float, default=0.3)
+    p.add_argument("--metrics-window-s", type=float, default=60.0)
+    p.add_argument("--latency-slo-s", type=float, default=None)
+    p.add_argument("--availability-target", type=float, default=None)
     args = p.parse_args(argv)
 
     stop = threading.Event()
@@ -470,8 +539,17 @@ def replica_main(argv: "list[str] | None" = None) -> int:
         rep = _Replica(args.index, replica_id=args.replica_id,
                        top_k=args.top_k, max_batch=args.max_batch,
                        scoring=args.scoring, poll_s=args.poll_s).start()
+        # the replica's OWN hub, not the lazy process default: windowed
+        # to the fleet's merge window and carrying the router-declared
+        # SLO budgets, so what this replica exports is federable and its
+        # burn rate is measured where the requests are actually served
+        hub = MetricsHub(window_s=args.metrics_window_s,
+                         latency_slo_s=args.latency_slo_s,
+                         availability_target=args.availability_target)
+        sink = TelemetrySink(hub)
+        obs.bus().attach(sink)
         exporter = obs.export.MetricsExporter(
-            obs.export.default_hub(), port=args.port,
+            hub, port=args.port,
             routes={("POST", "/query"): rep.handle_query,
                     ("GET", "/status"): rep.handle_status},
             ready=rep.ready,
@@ -487,6 +565,7 @@ def replica_main(argv: "list[str] | None" = None) -> int:
             # the router re-dispatches them on a sibling
             exporter.stop()
             rep.stop()
+            obs.bus().detach(sink)
     return 0
 
 
@@ -499,13 +578,18 @@ class ServingFabric:
     def __init__(self, index_dir: str, cfg: FabricConfig = FabricConfig()):
         self.index_dir = index_dir
         self.cfg = cfg
-        self._handles: list[procs.ProcessHandle] = []
-        self._ports: list[int] = []
+        # Membership is DYNAMIC (ISSUE 19): id-keyed maps instead of
+        # fixed-size lists, so scale_up/scale_down change the fleet while
+        # the ring keeps survivor-owned keys in place (a newcomer gets a
+        # fresh id; the newest id drains first).
+        self._handles: dict[int, procs.ProcessHandle] = {}
+        self._ports: dict[int, int] = {}
+        self._next_id = cfg.replicas
         self._suspect: set[int] = set()
         self._restarting: set[int] = set()
         self._down_since: dict[int, float] = {}
         self._ring = _Ring(range(cfg.replicas), cfg.ring_slots)
-        self._lock = threading.Lock()  # ports/suspects/audit/stats
+        self._lock = threading.Lock()  # membership/ports/suspects/audit/stats
         self._stats: collections.Counter = collections.Counter()
         self._audit: dict[str, int] = {}  # rid -> accepted deliveries
         self._rid_seq = itertools.count()
@@ -514,6 +598,16 @@ class ServingFabric:
         self._health_thread: threading.Thread | None = None
         self._sup_thread: threading.Thread | None = None
         self._started = False
+        # The fleet observability plane: scrape-and-merge hub + the
+        # router's own metrics endpoint (both None when federation=False).
+        self.fleet: FleetHub | None = None
+        self._fleet_exporter = None
+        if cfg.federation:
+            self.fleet = FleetHub(
+                window_s=cfg.fleet_window_s,
+                latency_slo_s=cfg.latency_slo_s,
+                availability_target=cfg.availability_target,
+            )
 
     # ----------------------------------------------------------- lifecycle
 
@@ -528,6 +622,18 @@ class ServingFabric:
                 "--poll-s", str(self.cfg.poll_s)]
         if self.cfg.max_batch is not None:
             argv += ["--max-batch", str(self.cfg.max_batch)]
+        if self.cfg.federation:
+            # the replica hub must share the fleet's merge window (the
+            # mergeable wire format rejects mismatched windows) and carry
+            # the SAME SLO budgets — replica-side budgets are what make
+            # the federated burn rate a real measured autoscale signal
+            # instead of a constant zero
+            argv += ["--metrics-window-s", str(self.cfg.fleet_window_s)]
+            if self.cfg.latency_slo_s is not None:
+                argv += ["--latency-slo-s", str(self.cfg.latency_slo_s)]
+            if self.cfg.availability_target is not None:
+                argv += ["--availability-target",
+                         str(self.cfg.availability_target)]
         return argv
 
     def _replica_env(self, i: int) -> dict[str, str]:
@@ -547,6 +653,10 @@ class ServingFabric:
                  generation=handle.ready.get("generation"))
         return handle
 
+    def _register_with_fleet(self, i: int, port: int) -> None:
+        if self.fleet is not None:
+            self.fleet.register(str(i), f"http://127.0.0.1:{port}")
+
     def start(self) -> "ServingFabric":
         if self._started:
             return self
@@ -554,8 +664,17 @@ class ServingFabric:
                  ring_slots=self.cfg.ring_slots, index_dir=self.index_dir)
         for i in range(self.cfg.replicas):
             handle = self._spawn(i)
-            self._handles.append(handle)
-            self._ports.append(int(handle.ready["port"]))
+            port = int(handle.ready["port"])
+            with self._lock:
+                self._handles[i] = handle
+                self._ports[i] = port
+            self._register_with_fleet(i, port)
+        if self.fleet is not None:
+            self.fleet.start()
+            self._fleet_exporter = obs.export.MetricsExporter(
+                self.fleet, port=0).start()
+            obs.emit("fabric_fleet_export", url=self._fleet_exporter.url,
+                     replicas=len(self._handles))
         self._started = True
         self._health_thread = threading.Thread(
             target=self._health_loop, name="fabric-health", daemon=True
@@ -573,10 +692,24 @@ class ServingFabric:
             if t is not None:
                 t.join(timeout=10.0)
         self._health_thread = self._sup_thread = None
-        for handle in self._handles:
+        if self._fleet_exporter is not None:
+            self._fleet_exporter.stop()
+            self._fleet_exporter = None
+        if self.fleet is not None:
+            self.fleet.stop()
+        with self._lock:
+            handles = list(self._handles.values())
+        for handle in handles:
             handle.terminate(self.cfg.grace_s)
         obs.emit("fabric_stop", **self.audit())
         self._started = False
+
+    @property
+    def fleet_url(self) -> str | None:
+        """The router's own metrics endpoint (fleet /snapshot.json +
+        /metrics), None until started or with federation off."""
+        ex = self._fleet_exporter
+        return None if ex is None else ex.url
 
     def __enter__(self) -> "ServingFabric":
         return self.start()
@@ -588,8 +721,15 @@ class ServingFabric:
 
     def _url(self, i: int, path: str) -> str:
         with self._lock:
-            port = self._ports[i]
+            port = self._ports.get(i)
+        if port is None:  # drained between route and dispatch: retry path
+            raise KeyError(f"replica {i} left the fleet")
         return f"http://127.0.0.1:{port}{path}"
+
+    def replica_ids(self) -> list[int]:
+        """The live fleet, sorted (membership snapshot under the lock)."""
+        with self._lock:
+            return sorted(self._handles)
 
     def _get_json(self, i: int, path: str, timeout: float) -> dict:
         with urllib.request.urlopen(self._url(i, path),
@@ -694,9 +834,9 @@ class ServingFabric:
 
     def _health_loop(self) -> None:
         while not self._stop.wait(self.cfg.health_period_s):
-            for i in range(self.cfg.replicas):
+            for i in self.replica_ids():
                 with self._lock:
-                    if i in self._restarting:
+                    if i in self._restarting or i not in self._handles:
                         continue
                 try:
                     status = self._get_json(i, "/status", timeout=2.0)
@@ -729,11 +869,13 @@ class ServingFabric:
 
     def _supervise_loop(self) -> None:
         while not self._stop.wait(self.cfg.poll_s):
-            for i in range(self.cfg.replicas):
+            for i in self.replica_ids():
                 with self._lock:
                     if i in self._restarting:
                         continue
-                handle = self._handles[i]
+                    handle = self._handles.get(i)
+                if handle is None:  # drained since the snapshot
+                    continue
                 if handle.alive():
                     with self._lock:
                         self._down_since.pop(i, None)
@@ -752,12 +894,17 @@ class ServingFabric:
                     self._mark_suspect(i, f"respawn failed: {exc}")
                     continue
                 recovery_s = time.monotonic() - t_down
+                port = int(fresh.ready["port"])
                 with self._lock:
+                    if i not in self._handles:  # drained mid-respawn
+                        fresh.terminate(self.cfg.grace_s)
+                        continue
                     self._handles[i] = fresh
-                    self._ports[i] = int(fresh.ready["port"])
+                    self._ports[i] = port
                     self._suspect.discard(i)
                     self._down_since.pop(i, None)
                     self._stats["respawns"] += 1
+                self._register_with_fleet(i, port)  # fresh ephemeral port
                 obs.emit("fabric_respawn", replica=i, pid=fresh.pid,
                          port=fresh.ready.get("port"),
                          recovery_s=round(recovery_s, 3))
@@ -766,7 +913,7 @@ class ServingFabric:
 
     def statuses(self, timeout: float = 2.0) -> list[dict | None]:
         out: list[dict | None] = []
-        for i in range(self.cfg.replicas):
+        for i in self.replica_ids():
             try:
                 out.append(self._get_json(i, "/status", timeout=timeout))
             except Exception:  # noqa: BLE001 — down replica = None
@@ -813,17 +960,23 @@ class ServingFabric:
                 f"fleet never reached generation {G} within {timeout}s"
             )
         commit_floor(self.index_dir, G)
-        obs.emit("fabric_roll_start", floor=G, replicas=self.cfg.replicas)
-        for i in range(self.cfg.replicas):
+        live = self.replica_ids()
+        obs.emit("fabric_roll_start", floor=G, replicas=len(live))
+        for i in live:
             with self._lock:
+                old = self._handles.get(i)
+                if old is None:  # drained while the roll was in flight
+                    continue
                 self._restarting.add(i)
                 self._suspect.add(i)  # route around it immediately
             t0 = time.monotonic()
-            self._handles[i].terminate(self.cfg.grace_s)
+            old.terminate(self.cfg.grace_s)
             fresh = self._spawn(i)
+            port = int(fresh.ready["port"])
             with self._lock:
                 self._handles[i] = fresh
-                self._ports[i] = int(fresh.ready["port"])
+                self._ports[i] = port
+            self._register_with_fleet(i, port)
             # back in rotation only once it serves ≥ the floor
             deadline = time.monotonic() + timeout
             while time.monotonic() < deadline:
@@ -855,6 +1008,66 @@ class ServingFabric:
         obs.emit("fabric_kill", replica=i, pid=pid)
         return pid
 
+    # ----------------------------------------------------------- scaling
+
+    def _rebuild_ring_locked(self) -> None:
+        self._ring = _Ring(sorted(self._handles), self.cfg.ring_slots)
+
+    def scale_up(self, n: int = 1) -> list[int]:
+        """Add ``n`` replicas under FRESH ids: the ring only gains vnodes,
+        so every key owned by a survivor keeps its owner (the churn
+        stability property) and only ~1/N of keys move to each newcomer.
+        Reuses the exact spawn/handshake machinery of start()/respawn."""
+        added: list[int] = []
+        for _ in range(max(0, n)):
+            with self._lock:
+                i = self._next_id
+                self._next_id += 1
+            handle = self._spawn(i)
+            port = int(handle.ready["port"])
+            with self._lock:
+                self._handles[i] = handle
+                self._ports[i] = port
+                self._rebuild_ring_locked()
+                self._stats["scale_ups"] += 1
+            self._register_with_fleet(i, port)
+            added.append(i)
+        return added
+
+    def scale_down(self, n: int = 1) -> list[int]:
+        """Drain the ``n`` newest replicas, never below one: a draining
+        replica leaves the ring FIRST (no new queries route to it), its
+        in-flight queries finish or fail typed into the sibling-retry
+        path (same rid — the dropped=0/double_served=0 audit holds across
+        every scale event), and only then is the process TERMed."""
+        removed: list[int] = []
+        for _ in range(max(0, n)):
+            with self._lock:
+                ids = sorted(self._handles)
+                if len(ids) <= 1:
+                    break
+                i = ids[-1]
+                handle = self._handles.pop(i)
+                self._ports.pop(i, None)
+                self._suspect.discard(i)
+                self._restarting.discard(i)
+                self._down_since.pop(i, None)
+                self._rebuild_ring_locked()
+                self._stats["scale_downs"] += 1
+            if self.fleet is not None:
+                self.fleet.deregister(str(i))
+            handle.terminate(self.cfg.grace_s)
+            obs.emit("fabric_drain", replica=i, pid=handle.pid)
+            removed.append(i)
+        return removed
+
+    def scale_to(self, n: int) -> None:
+        cur = len(self.replica_ids())
+        if n > cur:
+            self.scale_up(n - cur)
+        elif n < cur:
+            self.scale_down(cur - n)
+
     def audit(self) -> dict:
         """The router-side delivery audit: requests / delivered / failed
         (= dropped candidates) / retries / respawns, plus double_served =
@@ -866,12 +1079,177 @@ class ServingFabric:
             # are ALWAYS present so callers (and diffs) never KeyError
             out = {k: int(self._stats.get(k, 0))
                    for k in ("requests", "delivered", "retries", "failed",
-                             "respawns", "rolled")}
+                             "respawns", "rolled", "scale_ups",
+                             "scale_downs")}
             out["dropped"] = out["failed"]
             out["double_served"] = sum(
                 1 for n in self._audit.values() if n > 1
             )
         return out
+
+
+# ------------------------------------------------------------ autoscaler
+
+
+class Autoscaler:
+    """Burn-rate replica autoscaling — the ROADMAP fabric follow-on.
+
+    Reads ONLY the fleet hub (the same aggregate an operator sees at the
+    router's ``/snapshot.json``): availability/latency budget burn and
+    queue-wait p99 are the scale-up signals, sustained idle the
+    scale-down signal.  Actions go through the fabric's own
+    scale_up/scale_down (the supervisor's spawn/drain machinery), bounded
+    by ``[min_replicas, max_replicas]``, rate-limited by ``cooldown_s``
+    and hysteretic by config (see :class:`AutoscaleConfig`).
+
+    Every decision is published as an ``autoscale`` event carrying its
+    measured inputs — burn rates, queue p99, offered rate, fleet size
+    before/after and the triggering reason — so tools/trace_report.py
+    renders the scaling timeline and tools/trace_diff.py gates on flap
+    count (a direction reversal between consecutive actions)."""
+
+    def __init__(self, fabric: ServingFabric,
+                 cfg: AutoscaleConfig = AutoscaleConfig(), *,
+                 clock=time.monotonic):
+        if fabric.fleet is None:
+            raise ValueError("Autoscaler needs a fabric with federation=True")
+        self.fabric = fabric
+        self.cfg = cfg
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_action_t: float | None = None
+        self._idle_since: float | None = None
+        self._decisions = 0
+        self._ups = 0
+        self._downs = 0
+        self._flaps = 0
+        self._last_dir: str | None = None
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="fabric-autoscaler", daemon=True)
+            self._thread.start()
+            obs.emit("autoscale_start",
+                     min_replicas=self.cfg.min_replicas,
+                     max_replicas=self.cfg.max_replicas,
+                     cooldown_s=self.cfg.cooldown_s)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def __enter__(self) -> "Autoscaler":
+        return self.start()
+
+    def __exit__(self, *exc: object) -> None:
+        self.stop()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.period_s):
+            try:
+                self.tick()
+            except Exception as exc:  # noqa: BLE001 — a bad tick skips, never kills
+                obs.emit("autoscale_error",
+                         error=f"{type(exc).__name__}: {exc}"[:200])
+
+    @staticmethod
+    def _measure(snap: dict) -> dict:
+        """The decision inputs, extracted once so the emitted event and
+        the decision logic can never disagree on what was measured."""
+        budgets = snap.get("budgets") or {}
+        qwin = snap.get("queue_wait_s") or {}
+        ctr = snap.get("counters") or {}
+        q_p99 = qwin.get("p99")
+        return {
+            "burn_availability": (budgets.get("availability") or {}).get(
+                "burn_rate", 0.0),
+            "burn_latency": (budgets.get("latency") or {}).get(
+                "burn_rate", 0.0),
+            "queue_p99_ms": (None if q_p99 is None
+                             else round(float(q_p99) * 1e3, 3)),
+            "rate_per_s": (ctr.get("serve.requests") or {}).get(
+                "rate_per_s", 0.0),
+        }
+
+    def tick(self, snap: "dict | None" = None) -> str:
+        """One control-loop evaluation (injectable snapshot for tests and
+        the CI forced-decision smoke); returns the action taken:
+        ``"up"``, ``"down"``, or ``"hold"``."""
+        fleet = self.fabric.fleet
+        assert fleet is not None  # checked at construction
+        if snap is None:
+            snap = fleet.snapshot()
+        m = self._measure(snap)
+        n = len(self.fabric.replica_ids())
+        now = self._clock()
+        self._decisions += 1
+
+        burn = max(float(m["burn_availability"]), float(m["burn_latency"]))
+        q_hot = (m["queue_p99_ms"] is not None
+                 and m["queue_p99_ms"] >= self.cfg.queue_p99_up_s * 1e3)
+        pressure = burn >= self.cfg.burn_up or q_hot
+        idle = (float(m["rate_per_s"]) <= self.cfg.idle_rate_down
+                and burn < self.cfg.burn_down)
+        if idle:
+            if self._idle_since is None:
+                self._idle_since = now
+        else:
+            self._idle_since = None
+        idle_held = (self._idle_since is not None
+                     and now - self._idle_since >= self.cfg.idle_hold_s)
+        cooling = (self._last_action_t is not None
+                   and now - self._last_action_t < self.cfg.cooldown_s)
+
+        action, reason = "hold", "steady"
+        if pressure and cooling:
+            reason = "cooldown"
+        elif pressure and n >= self.cfg.max_replicas:
+            reason = "at_max"
+        elif pressure:
+            action = "up"
+            reason = "burn" if burn >= self.cfg.burn_up else "queue_p99"
+        elif idle_held and cooling:
+            reason = "cooldown"
+        elif idle_held and n <= self.cfg.min_replicas:
+            reason = "at_min"
+        elif idle_held:
+            action, reason = "down", "idle"
+
+        if action == "hold":
+            return action
+
+        if action == "up":
+            added = self.fabric.scale_up(1)
+            self._ups += 1
+        else:
+            added = self.fabric.scale_down(1)
+            self._downs += 1
+            self._idle_since = None  # re-arm the idle hold after a drain
+        self._last_action_t = now
+        if self._last_dir is not None and self._last_dir != action:
+            self._flaps += 1
+        self._last_dir = action
+        obs.emit("autoscale", action=action, reason=reason,
+                 replicas_before=n, replicas_after=len(
+                     self.fabric.replica_ids()),
+                 changed=added, **m)
+        return action
+
+    def stats(self) -> dict:
+        """Always-present decision tallies (bench's ``extra.autoscale``
+        and the trace_diff flap gate read these names)."""
+        return {
+            "decisions": self._decisions,
+            "ups": self._ups,
+            "downs": self._downs,
+            "flaps": self._flaps,
+        }
 
 
 def main(argv: "list[str] | None" = None) -> int:

@@ -914,12 +914,33 @@ class FleetSoakConfig:
     scoring: str = "coo"
     retry_limit: int = 120  # router re-dispatch budget per request
     retry_pause_s: float = 0.25
+    # --- stepped-load autoscale scenario (ISSUE 19) -------------------
+    # With ``autoscale=True`` the fleet starts at ONE replica and an
+    # :class:`~.fabric.Autoscaler` (reading only the federated hub) owns
+    # fleet size: clients stay quiet until ``step_at_s``, hammer at full
+    # qps until ``idle_at_s``, then go quiet again — the burst's real
+    # latencies burn the (deliberately tight) fleet latency budget and
+    # scale 1→``replicas``; the idle tail drains the metrics window and
+    # scales back down.  SIGKILL/rolling-restart default OFF here: the
+    # scale events ARE the membership chaos being audited.
+    autoscale: bool = False
+    step_at_s: float | None = None  # burst start; default duration/4
+    idle_at_s: float | None = None  # burst end; default 0.55 * duration
+    cooldown_s: float = 4.0  # * GRAFT_AUTOSCALE_COOLDOWN_S via from_env
+    fleet_window_s: float = 10.0  # fleet + replica metrics window —
+    # short on purpose so the idle tail's rate/burn decay fits the soak
+    autoscale_latency_slo_ms: float = 0.1  # fleet latency budget bound:
+    # tighter than any real cross-process serve, so burst traffic burns
+    # it hard and the scaler sees genuine measured pressure
 
     def __post_init__(self) -> None:
         if self.duration_s <= 0 or self.qps <= 0 or self.clients < 1:
             raise ValueError("duration_s, qps and clients must be positive")
         if self.replicas < 1:
             raise ValueError("replicas must be >= 1")
+        if self.autoscale and self.replicas < 2:
+            raise ValueError("autoscale soak needs replicas >= 2 "
+                             "(the scale-up target)")
         if not 0.0 < self.availability_target < 1.0:
             raise ValueError("availability_target must be in (0, 1)")
 
@@ -941,6 +962,9 @@ class FleetSoakConfig:
         raw = os.environ.get("GRAFT_SOAK_SLO_AVAILABILITY")
         if raw:
             env["availability_target"] = float(raw)
+        raw = os.environ.get("GRAFT_AUTOSCALE_COOLDOWN_S")
+        if raw:
+            env["cooldown_s"] = float(raw)
         env.update(overrides)
         return cls(**env)
 
@@ -972,6 +996,13 @@ class _FleetSoak:
         # router-side delivery ledger, snapshotted by run() right before
         # fabric.stop() tears the fleet down
         self._last_audit: dict | None = None
+        # stepped-load gate: clients only send while set (always set in
+        # the classic scenario; run() steps it in autoscale mode)
+        self._load_on = threading.Event()
+        if not cfg.autoscale:
+            self._load_on.set()
+        self._scaler_stats: dict | None = None
+        self._fleet_final: dict | None = None
         self.hub = MetricsHub(
             window_s=cfg.window_s,
             latency_slo_s=cfg.slo_p99_ms / 1e3,
@@ -1044,6 +1075,14 @@ class _FleetSoak:
         with self._lock:
             self._client_results[idx] = results
         while not self._client_stop.is_set():
+            if not self._load_on.is_set():
+                # stepped load: idle phase — and re-arm the pacing clock
+                # so the burst starts at full qps, not with a backlog of
+                # catch-up sends
+                self._client_stop.wait(0.05)
+                next_t = time.perf_counter() + float(
+                    rng.uniform(0, interval))
+                continue
             now = time.perf_counter()
             if now < next_t:
                 self._client_stop.wait(min(next_t - now, 0.05))
@@ -1083,6 +1122,7 @@ class _FleetSoak:
         recoveries: list[dict] = []
         kills = 0
         roll: dict | None = None
+        scaler: fab.Autoscaler | None = None
         try:
             with obs.span("fleet.bootstrap"):
                 boot = [next(gen) for _ in range(cfg.bootstrap_chunks)]
@@ -1092,22 +1132,50 @@ class _FleetSoak:
                         len(d.split()) for c in boot for d in c
                     )
                 self._fleet_seal_delta(boot, self._fleet_stream_cfg())
+                fabric_cfg = fab.FabricConfig(
+                    replicas=1 if cfg.autoscale else cfg.replicas,
+                    top_k=cfg.top_k,
+                    scoring=cfg.scoring,
+                    retry_limit=cfg.retry_limit,
+                    retry_pause_s=cfg.retry_pause_s,
+                    grace_s=cfg.grace_s,
+                )
+                if cfg.autoscale:
+                    # the scaler reads ONLY the federated hub, so the
+                    # fleet must carry real budgets: a tight latency SLO
+                    # the burst will burn, the scenario's availability
+                    # target, and a short window the idle tail can drain
+                    fabric_cfg = dataclasses.replace(
+                        fabric_cfg,
+                        fleet_window_s=cfg.fleet_window_s,
+                        latency_slo_s=cfg.autoscale_latency_slo_ms / 1e3,
+                        availability_target=cfg.availability_target,
+                    )
                 self.fabric = fab.ServingFabric(
-                    self.index_dir,
-                    fab.FabricConfig(
-                        replicas=cfg.replicas, top_k=cfg.top_k,
-                        scoring=cfg.scoring,
-                        retry_limit=cfg.retry_limit,
-                        retry_pause_s=cfg.retry_pause_s,
-                        grace_s=cfg.grace_s,
-                    ),
+                    self.index_dir, fabric_cfg,
                 ).start()
+            if cfg.autoscale:
+                scaler = fab.Autoscaler(self.fabric, fab.AutoscaleConfig(
+                    min_replicas=1, max_replicas=cfg.replicas,
+                    cooldown_s=cfg.cooldown_s, period_s=0.5,
+                    idle_rate_down=0.5, idle_hold_s=2.0,
+                )).start()
             self._t0 = time.perf_counter()
             deadline = self._t0 + cfg.duration_s
+            # autoscale mode: scale events are the membership chaos; the
+            # SIGKILL/rolling-restart timeline stays opt-in via explicit
+            # kill_at_s / roll_at_s
             kill_at = (cfg.kill_at_s if cfg.kill_at_s is not None
-                       else cfg.duration_s / 3.0)
+                       else None if cfg.autoscale else cfg.duration_s / 3.0)
             roll_at = (cfg.roll_at_s if cfg.roll_at_s is not None
+                       else None if cfg.autoscale
                        else 2.0 * cfg.duration_s / 3.0)
+            step_at = ((cfg.step_at_s if cfg.step_at_s is not None
+                        else cfg.duration_s / 4.0)
+                       if cfg.autoscale else None)
+            idle_at = ((cfg.idle_at_s if cfg.idle_at_s is not None
+                        else 0.55 * cfg.duration_s)
+                       if cfg.autoscale else None)
             obs.emit("fleet_soak_start", duration_s=cfg.duration_s,
                      qps=cfg.qps, replicas=cfg.replicas,
                      clients=cfg.clients)
@@ -1128,6 +1196,16 @@ class _FleetSoak:
             victim = 0
             while time.perf_counter() < deadline:
                 now_s = time.perf_counter() - self._t0
+                if step_at is not None and now_s >= step_at:
+                    step_at = None
+                    self._load_on.set()
+                    obs.emit("fleet_step", phase="burst",
+                             at_s=round(now_s, 3))
+                if idle_at is not None and now_s >= idle_at:
+                    idle_at = None
+                    self._load_on.clear()
+                    obs.emit("fleet_step", phase="idle",
+                             at_s=round(now_s, 3))
                 if kill_at is not None and now_s >= kill_at:
                     kill_at = None
                     killed_pid = self.fabric.kill_replica(victim)
@@ -1167,10 +1245,24 @@ class _FleetSoak:
                 c.join(timeout=cfg.request_timeout_s + cfg.grace_s)
             self._stop.set()
             threads[0].join(timeout=60.0)
-            # snapshot the ledger BEFORE stop() tears the fleet down
+            # snapshot the ledger (and the scaler's tallies + the fleet
+            # board) BEFORE stop() tears the fleet down
+            if scaler is not None:
+                scaler.stop()
+                self._scaler_stats = scaler.stats()
+                if self.fabric.fleet is not None:
+                    fs = self.fabric.fleet.snapshot()["fleet"]
+                    self._fleet_final = {
+                        "replicas": len(fs["replicas"]),
+                        "stale": len(fs["stale"]),
+                        "scrapes": fs["scrapes"],
+                        "scrape_errors": fs["scrape_errors"],
+                    }
             self._last_audit = self.fabric.audit()
             return self._score(actual_s, recoveries, kills, roll)
         finally:
+            if scaler is not None:
+                scaler.stop()
             self._stop.set()
             self._client_stop.set()
             fabric, self.fabric = self.fabric, None
@@ -1241,6 +1333,17 @@ class _FleetSoak:
                 "floor": fab.read_floor(self.index_dir),
                 "retries": int(audit.get("retries", 0)),
             },
+            # autoscale scenario read-outs (None in the classic fleet
+            # soak): the scaler's decision tallies, the router audit's
+            # membership-change counts, and the final fleet board
+            "autoscale": (
+                None if self._scaler_stats is None else {
+                    **self._scaler_stats,
+                    "scale_ups": int(audit.get("scale_ups", 0)),
+                    "scale_downs": int(audit.get("scale_downs", 0)),
+                    "federation": self._fleet_final,
+                }
+            ),
             "mixed_traffic": mixed,
             "slo_targets": {
                 "p99_ms": self.cfg.slo_p99_ms,

@@ -92,6 +92,19 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         # recovery asserted with dropped=0; read in bash; default 25s)
         "GRAFT_FABRIC_REPLICAS",  # serving/fabric.py: replica-fleet size
         # the fleet soak / FabricConfig.from_env defaults to (default 2)
+        "GRAFT_FED_SCRAPE_S",  # obs/federation.py: seconds between fleet
+        # metrics scrapes of each replica's /snapshot.json (default 1.0;
+        # a replica unanswered for 3 periods is labeled stale)
+        "GRAFT_FED_BUDGET_S",  # tools/ci.sh wall-clock budget for the
+        # federation+autoscale smoke (scrape → merged snapshot parses →
+        # one forced scale-up decision; read in bash; default 25s)
+        "GRAFT_AUTOSCALE_MIN",  # serving/fabric.py AutoscaleConfig: the
+        # autoscaler's replica-count floor (default 1)
+        "GRAFT_AUTOSCALE_MAX",  # serving/fabric.py AutoscaleConfig: the
+        # autoscaler's replica-count ceiling (default 4)
+        "GRAFT_AUTOSCALE_COOLDOWN_S",  # serving/fabric.py AutoscaleConfig:
+        # minimum seconds between scale actions (default 10; the flap
+        # gate in tools/trace_diff.py leans on this)
     }
 )
 
@@ -229,6 +242,15 @@ THREAD_REGISTRY: tuple = (
     ("fabric-supervisor",
      "page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py",
      ("ServingFabric._lock",)),  # handle/port swap on respawn
+    ("fed-scraper",
+     "page_rank_and_tfidf_using_apache_spark_tpu/obs/federation.py",
+     # per-replica mergeable/staleness state under the fleet hub's lock;
+     # the guarded fetch itself runs on a resilience-* watchdog thread
+     ("FleetHub._lock",)),
+    ("fabric-autoscaler",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py",
+     # scale_up/scale_down swap membership + ring under the router's lock
+     ("ServingFabric._lock",)),
 )
 
 

@@ -265,7 +265,8 @@ def _stub_router(tmp_path, ports, **cfg_overrides):
     cfg = fabric.FabricConfig(replicas=len(ports), retry_pause_s=0.01,
                               request_timeout_s=5.0, **cfg_overrides)
     fab = fabric.ServingFabric(str(tmp_path), cfg)
-    fab._ports = list(ports)  # routed without start(): no child processes
+    # routed without start(): no child processes (id-keyed since ISSUE 19)
+    fab._ports = dict(enumerate(ports))
     return fab
 
 

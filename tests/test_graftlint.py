@@ -838,6 +838,7 @@ def test_every_rule_has_summary():
         "thread-registry-drift",
         "env-knob-drift",
         "ladder-rung-drift",
+        "metric-name-drift",
         "sync-put-in-ingest-loop",
     }
     for rule in RULES.values():

@@ -490,3 +490,107 @@ print("malformed-message fabric scenario: OK — typed 400s under "
       "replica_swap:proc_kill@1 kill seam verified, "
       "dropped=0 double_served=0")
 EOF
+
+# ---------------------------------------------------------------------------
+# scrape-chaos scenario (ISSUE 19): the fleet observability plane must
+# degrade to STALENESS, never to routing impact.  fed_scrape:net_partition
+# severs every scrape mid-traffic — queries keep routing, the audit stays
+# dropped=0 / double_served=0, the partitioned replicas are LABELED stale
+# (never dropped from the board, last-known state kept in the aggregate)
+# and recover to fresh once the partition lifts; fed_scrape:net_hang then
+# stalls scrapes on the scraper thread while the query path stays live.
+echo "== chaos: fleet scrape partition/hang (fed_scrape) =="
+python - <<'EOF'
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path.cwd()))
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.resilience import chaos
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import fabric
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import segments as sgm
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    Bm25Config,
+    TfidfConfig,
+)
+
+# fast scrape cadence so staleness (3 missed scrapes) is observable in
+# a bounded scenario: stale after 0.6s
+os.environ["GRAFT_FED_SCRAPE_S"] = "0.2"
+
+scfg = TfidfConfig(vocab_bits=10)
+docs = ["node edge graph rank walk", "graph node directed edge weight",
+        "rank walk teleport damping node", "edge list sparse matrix graph"]
+tmp = tempfile.mkdtemp(prefix="chaos-scrape-")
+out = run_tfidf(docs, scfg)
+ref = sgm.seal_segment(tmp, out, scfg, doc_base=0,
+                       ranks=np.ones(out.n_docs, np.float32),
+                       bm25=Bm25Config())
+sgm.commit_append(tmp, ref, scfg.config_hash())
+
+fab = fabric.ServingFabric(tmp, fabric.FabricConfig(
+    replicas=2, poll_s=0.1, health_period_s=0.2, retry_limit=100,
+    retry_pause_s=0.1, grace_s=10.0,
+))
+with fab:
+    for _ in range(6):
+        scores, _ = fab.query(["node"])
+        assert len(scores) > 0
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        snap = fab.fleet.snapshot()
+        if (snap["counters"].get("serve.requests", {}).get("total", 0) >= 1
+                and not snap["fleet"]["stale"]):
+            break
+        time.sleep(0.2)
+    assert len(snap["fleet"]["replicas"]) == 2, snap["fleet"]
+    assert not snap["fleet"]["stale"], snap["fleet"]
+    base_total = snap["counters"]["serve.requests"]["total"]
+    assert base_total >= 1, snap["counters"]
+
+    # every scrape severed: routing must not notice, the board must
+    # label (never drop) the unreachable replicas and keep their
+    # last-known contribution in the aggregate
+    with chaos.inject("fed_scrape:net_partition@1+"):
+        for _ in range(10):
+            scores, _ = fab.query(["graph"])
+            assert len(scores) > 0
+        time.sleep(1.0)  # > stale_after_s (0.6): three missed scrapes
+        snap2 = fab.fleet.snapshot()
+        assert snap2["fleet"]["replicas"] == snap["fleet"]["replicas"], \
+            snap2["fleet"]  # partitioned replicas never dropped
+        assert len(snap2["fleet"]["stale"]) == 2, snap2["fleet"]
+        assert snap2["fleet"]["per_replica"]["0"]["stale"], snap2["fleet"]
+        kept = snap2["counters"]["serve.requests"]["total"]
+        assert kept >= base_total, (kept, base_total)  # last-known kept
+    assert snap2["fleet"]["scrape_errors"] >= 2, snap2["fleet"]
+
+    # partition lifted: the scraper recovers the fleet to fresh
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if not fab.fleet.snapshot()["fleet"]["stale"]:
+            break
+        time.sleep(0.2)
+    assert not fab.fleet.snapshot()["fleet"]["stale"]
+
+    # hung scrapes stall the scraper thread, not the query path
+    with chaos.inject("fed_scrape:net_hang@1+:400"):
+        for _ in range(10):
+            scores, _ = fab.query(["rank"])
+            assert len(scores) > 0
+    audit = fab.audit()
+
+assert audit["dropped"] == 0, audit
+assert audit["double_served"] == 0, audit
+assert audit["requests"] == 26 and audit["delivered"] == 26, audit
+
+print("scrape-chaos scenario: OK — 26/26 delivered under "
+      "fed_scrape:net_partition@1+ + net_hang@1+:400, both replicas "
+      "labeled stale (never dropped), aggregate kept last-known state, "
+      "fleet recovered to fresh, dropped=0 double_served=0")
+EOF

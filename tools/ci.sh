@@ -416,6 +416,99 @@ if [ "$dt" -gt "${GRAFT_FABRIC_BUDGET_S:-25}" ]; then
     exit 1
 fi
 
+echo "== federation smoke (fleet scrape → merged board → forced scale-up, budget ${GRAFT_FED_BUDGET_S:-25}s) =="
+# The ISSUE 19 observability plane as a bounded CI gate: a 1-replica
+# fleet with the router-side FleetHub, one real scrape sweep, the
+# router's OWN /snapshot.json must serve a parseable merged fleet board
+# (replica rows + counters folded exactly), then one forced scale-up
+# through the autoscaler's own spawn path — and the run's trace must
+# render tools/trace_report.py's autoscale timeline.
+t0=$(date +%s)
+if ! env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    FED_SMOKE_DIR="$smoke_dir" \
+    python - > "$smoke_dir/federation.log" 2>&1 <<'EOF'
+import importlib.util
+import json
+import os
+import urllib.request
+
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu import obs
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import fabric
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import segments as sgm
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    Bm25Config,
+    TfidfConfig,
+)
+
+d = os.path.join(os.environ["FED_SMOKE_DIR"], "fedidx")
+scfg = TfidfConfig(vocab_bits=9)
+docs = [f"alpha beta doc{i} shared word graph node" for i in range(8)]
+out = run_tfidf(docs, scfg)
+ref = sgm.seal_segment(d, out, scfg, doc_base=0,
+                       ranks=np.ones(out.n_docs, np.float32),
+                       bm25=Bm25Config())
+sgm.commit_append(d, ref, scfg.config_hash())
+trace_dir = os.path.join(os.environ["FED_SMOKE_DIR"], "fedtrace")
+with obs.run("fed_smoke", trace_dir=trace_dir) as r:
+    cfg = fabric.FabricConfig(replicas=1, poll_s=0.1, health_period_s=0.2,
+                              retry_limit=100, retry_pause_s=0.1,
+                              grace_s=10.0, latency_slo_s=0.5,
+                              availability_target=0.999)
+    with fabric.ServingFabric(d, cfg) as fab:
+        for _ in range(8):
+            fab.query(["alpha", "beta"])
+        fab.fleet.scrape_once()
+        # the router's OWN exporter serves the merged fleet board
+        with urllib.request.urlopen(fab.fleet_url + "/snapshot.json",
+                                    timeout=5) as resp:
+            snap = json.loads(resp.read())
+        assert snap["fleet"]["replicas"], snap["fleet"]
+        total = snap["counters"]["serve.requests"]["total"]
+        assert total >= 8, snap["counters"]
+        # one forced scale-up through the autoscaler's own spawn path
+        scaler = fabric.Autoscaler(fab, fabric.AutoscaleConfig(
+            min_replicas=1, max_replicas=2, cooldown_s=0.0))
+        action = scaler.tick(
+            {"budgets": {"availability": {"burn_rate": 10.0}}})
+        assert action == "up", action
+        assert len(fab.replica_ids()) == 2, fab.replica_ids()
+        for _ in range(4):
+            fab.query(["shared", "word"])
+        audit = fab.audit()
+assert audit["dropped"] == 0 and audit["double_served"] == 0, audit
+assert audit["scale_ups"] >= 1, audit
+spec = importlib.util.spec_from_file_location("tr", "tools/trace_report.py")
+tr = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(tr)
+rep = tr.report(r.trace_path)
+a = rep["autoscale"]
+assert a is not None and a["ups"] >= 1 and a["actions"] >= 1, a
+spec = importlib.util.spec_from_file_location("sw", "tools/slo_watch.py")
+sw = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(sw)
+board = sw.render_fleet(snap)
+assert "fleet:" in board, board
+print(f"federation smoke: OK — scraped {len(snap['fleet']['replicas'])} "
+      f"replica(s), merged {int(total)} requests exactly, forced "
+      f"scale-up to {audit['scale_ups'] + 1} replicas, autoscale "
+      f"timeline rendered ({a['actions']} action(s))")
+EOF
+then
+    echo "FAIL: federation smoke; its output:" >&2
+    cat "$smoke_dir/federation.log" >&2
+    exit 1
+fi
+tail -1 "$smoke_dir/federation.log"
+dt=$(( $(date +%s) - t0 ))
+echo "federation smoke: ${dt}s"
+if [ "$dt" -gt "${GRAFT_FED_BUDGET_S:-25}" ]; then
+    echo "FAIL: federation smoke exceeded its ${GRAFT_FED_BUDGET_S:-25}s budget (${dt}s) — the fleet scrape/scale path stopped being interactive" >&2
+    exit 1
+fi
+
 echo "== segment smoke (seal → serve → post-start commit → merge under *:fail@%5, budget ${GRAFT_SEG_BUDGET_S:-15}s) =="
 # The ISSUE 13 ingest→servable path as a bounded CI gate: seal a delta
 # segment, serve it via impacted-list scoring, commit a SECOND segment

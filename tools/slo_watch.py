@@ -11,11 +11,18 @@ inspectable *while it runs*, no SIGKILL post-mortem required.
 Deliberately stdlib-only (same rule as trace_report.py/trace_diff.py: it
 must run from any jax-free shell).
 
+``--fleet`` renders the federation board (ISSUE 19) when pointed at a
+router exporting a :class:`obs.federation.FleetHub`: the exact merged
+aggregate first, then one row per replica (requests/errors/quantiles)
+with its scrape staleness — stale replicas are labeled ``STALE``, never
+dropped, mirroring the fleet snapshot's contract.
+
 Usage::
 
     python tools/slo_watch.py --port 9109            # loop, 2s refresh
     python tools/slo_watch.py --port 9109 --once     # one snapshot
     python tools/slo_watch.py --url http://host:9109 --json
+    python tools/slo_watch.py --port 9109 --fleet    # federation board
 """
 
 from __future__ import annotations
@@ -80,6 +87,41 @@ def render(snap: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def render_fleet(snap: dict[str, Any]) -> str:
+    """The federation board: merged aggregate + per-replica rows (pure
+    function — unit tested without a fleet).  Falls back to the plain
+    board when the snapshot carries no ``fleet`` section."""
+    fleet = snap.get("fleet")
+    if not isinstance(fleet, dict):
+        return render(snap) + "\n(no fleet section: not a FleetHub endpoint)"
+    lines = [render(snap)]
+    n = len(fleet.get("replicas") or [])
+    stale = fleet.get("stale") or []
+    lines.append(
+        f"fleet: {n} replica(s), {len(stale)} stale "
+        f"(scrape every {fleet.get('scrape_s')}s, stale after "
+        f"{fleet.get('stale_after_s')}s; {fleet.get('scrapes', 0)} scrapes, "
+        f"{fleet.get('scrape_errors', 0)} errors)"
+    )
+    per = fleet.get("per_replica") or {}
+    if per:
+        lines.append(
+            f"  {'replica':10s} {'requests':>9s} {'errors':>7s} "
+            f"{'p50 ms':>8s} {'p99 ms':>8s} {'age s':>7s}"
+        )
+        for r, row in sorted(per.items()):
+            lines.append(
+                f"  {r:10s} {row.get('requests', 0):9.0f} "
+                f"{row.get('errors', 0):7.0f} {_ms(row.get('p50_s'))} "
+                f"{_ms(row.get('p99_s'))} {row.get('staleness_s', 0.0):7.2f}"
+                f"{'  STALE' if row.get('stale') else ''}"
+            )
+    merge_errors = fleet.get("merge_errors") or {}
+    for r, err in sorted(merge_errors.items()):
+        lines.append(f"  merge error [{r}]: {err}")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="slo_watch", description=__doc__)
     ap.add_argument("--url", default=None,
@@ -92,6 +134,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="print one snapshot and exit")
     ap.add_argument("--json", action="store_true",
                     help="raw snapshot JSON instead of the board")
+    ap.add_argument("--fleet", action="store_true",
+                    help="federation board: aggregate + per-replica rows "
+                         "with staleness (point at a router's FleetHub "
+                         "exporter)")
     args = ap.parse_args(argv)
     url = args.url or f"http://{args.host}:{args.port}"
 
@@ -108,7 +154,7 @@ def main(argv: list[str] | None = None) -> int:
                 sys.stdout.write("\x1b[2J\x1b[H")  # clear, home
             print(f"slo_watch {url}  "
                   f"@ {time.strftime('%H:%M:%S')}")
-            print(render(snap))
+            print(render_fleet(snap) if args.fleet else render(snap))
             sys.stdout.flush()
         if args.once:
             return 0

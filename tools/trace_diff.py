@@ -385,6 +385,94 @@ def diff_fabric(
     return rows
 
 
+# One up->down reversal is inherent to a stepped-load round (scale up
+# under load, back down when it recedes) — only MORE reversals than both
+# the old round and this allowance indicate control-loop oscillation.
+AUTOSCALE_FLAP_ALLOWANCE = 1
+
+
+def load_autoscale(path: str) -> dict | None:
+    """Federation/autoscaling numbers riding a BENCH round (ISSUE 19):
+    the always-present ``extra.autoscale`` decision tallies (decisions/
+    ups/downs/flaps) and ``extra.fleet_federation`` fleet board (replicas
+    scraped, stale count, max staleness, fleet-aggregate p99) — both null
+    on a failed fabric child.  None when the round predates the
+    federation bench — the old-round fallback that arms the gate on the
+    first new round."""
+    if path.endswith(".jsonl"):
+        return None
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(record.get("parsed"), dict):
+        record = record["parsed"]
+    extra = record.get("extra", {})
+    if "autoscale" not in extra:
+        return None
+    scale = extra.get("autoscale") or {}
+    fed = extra.get("fleet_federation") or {}
+    return {
+        "flaps": scale.get("flaps"),
+        "ups": scale.get("ups"),
+        "downs": scale.get("downs"),
+        "fleet_p99_ms": fed.get("p99_ms"),
+        "stale": fed.get("stale"),
+    }
+
+
+def diff_autoscale(
+    old: dict | None, new: dict | None, threshold: float
+) -> list[dict]:
+    """Autoscaling regression rows (ISSUE 19): flap count (direction
+    reversals between consecutive scale actions) may not grow past both
+    the old round and the one-reversal stepped-load allowance — a
+    flapping control loop churns replicas without adding capacity — and
+    the fleet-aggregate p99 (the exact federated merge, the number an
+    operator alerts on) may not regress relatively past ``threshold``
+    over the same absolute jitter floor as the per-replica SLO gate.
+    Null values (failed fabric child) on either side skip the
+    comparison; a round losing its numbers while the old one had them is
+    itself flagged."""
+    if old is None:
+        return []
+    if new is None:
+        return [{
+            "key": "autoscale.missing",
+            "old": "present",
+            "new": None,
+            "why": "the old round carried federation/autoscale numbers "
+                   "and the new one does not — the round lost its "
+                   "federation bench",
+        }]
+    rows: list[dict] = []
+    o_f, n_f = old.get("flaps"), new.get("flaps")
+    if (isinstance(o_f, int) and isinstance(n_f, int) and n_f > o_f
+            and n_f > AUTOSCALE_FLAP_ALLOWANCE):
+        rows.append({
+            "key": "autoscale.flaps",
+            "old": o_f,
+            "new": n_f,
+            "why": "the autoscaler reversed direction more often — a "
+                   "flapping control loop churns replicas without adding "
+                   "capacity",
+        })
+    o_p, n_p = old.get("fleet_p99_ms"), new.get("fleet_p99_ms")
+    if (o_p is not None and n_p is not None
+            and n_p > o_p * (1.0 + threshold)
+            and n_p - o_p > SLO_MIN_DELTA_MS):
+        rows.append({
+            "key": "autoscale.fleet_p99_ms",
+            "old": o_p,
+            "new": n_p,
+            "why": f"fleet-aggregate served p99 grew "
+                   f"{n_p / max(o_p, 1e-9):.2f}x — the federated board "
+                   "an operator alerts on regressed",
+        })
+    return rows
+
+
 def load_tuned_stamp(path: str) -> dict | None:
     """Tuned-profile provenance riding a BENCH round: the backend the
     committed profile was stamped with (``extra.tuned_profile.backend``,
@@ -554,6 +642,8 @@ def main(argv: list[str] | None = None) -> int:
                           load_comm_bytes(args.new), args.threshold)
     fabric_rows = diff_fabric(load_fabric(args.old),
                               load_fabric(args.new), args.threshold)
+    autoscale_rows = diff_autoscale(load_autoscale(args.old),
+                                    load_autoscale(args.new), args.threshold)
     tuned_rows = check_tuned_backend(load_tuned_stamp(args.new))
     all_regressions = (
         [r["phase"] for r in regressions]
@@ -561,6 +651,7 @@ def main(argv: list[str] | None = None) -> int:
         + [r["key"] for r in served_rows]
         + [r["key"] for r in comm_rows]
         + [r["key"] for r in fabric_rows]
+        + [r["key"] for r in autoscale_rows]
         + [r["key"] for r in tuned_rows]
     )
     result = {
@@ -571,6 +662,7 @@ def main(argv: list[str] | None = None) -> int:
         "served": served_rows,
         "comm": comm_rows,
         "fabric": fabric_rows,
+        "autoscale": autoscale_rows,
         "tuned_profile": tuned_rows,
         "regressions": all_regressions,
         "worst_regression": all_regressions[0] if all_regressions else None,
@@ -591,7 +683,8 @@ def main(argv: list[str] | None = None) -> int:
             mark = " <-- REGRESSED" if r["phase"] in result["regressions"] else ""
             print(f"{r['phase']:28s} {r['old_secs']:9.3f} {r['new_secs']:9.3f} "
                   f"{r['delta_secs']:+9.3f}  {rel}{mark}")
-        for r in slo_rows + served_rows + comm_rows + fabric_rows + tuned_rows:
+        for r in (slo_rows + served_rows + comm_rows + fabric_rows
+                  + autoscale_rows + tuned_rows):
             print(f"{r['key']:28s} {r['old']!s:>9s} {r['new']!s:>9s}  "
                   f"{r['why']} <-- REGRESSED")
         if all_regressions:

@@ -348,6 +348,37 @@ def report(path: str) -> dict[str, Any]:
             ),
         }
 
+    # Autoscaling timeline (ISSUE 19): the burn-rate autoscaler publishes
+    # one ``autoscale`` event per ACTION (holds are silent) carrying the
+    # measured inputs that drove it — burn rates, queue p99, offered
+    # rate, fleet size before/after.  Flaps (direction reversals between
+    # consecutive actions) are recomputed from the timeline so the
+    # trace_diff gate never trusts a counter the process could misreport;
+    # fed_scrape_error tallies ride along (scrape chaos forensics).
+    as_events = [e for e in events if e["kind"] == "autoscale"]
+    autoscale = None
+    if as_events or any(e["kind"] == "autoscale_start" for e in events):
+        timeline = []
+        for e in as_events:
+            row = {k: v for k, v in e.items()
+                   if k not in ("kind", "t", "wall", "thread", "seq")}
+            row["t_rel"] = round(e["t"] - t0, 3)
+            timeline.append(row)
+        autoscale = {
+            "actions": len(as_events),
+            "ups": sum(e.get("action") == "up" for e in as_events),
+            "downs": sum(e.get("action") == "down" for e in as_events),
+            "flaps": sum(
+                1 for prev, cur in zip(as_events, as_events[1:])
+                if prev.get("action") != cur.get("action")
+            ),
+            "errors": sum(e["kind"] == "autoscale_error" for e in events),
+            "scrape_errors": sum(
+                e["kind"] == "fed_scrape_error" for e in events
+            ),
+            "timeline": timeline,
+        }
+
     manifest = None
     mpath = path.replace(".trace.jsonl", ".manifest.json")
     if mpath != path and os.path.exists(mpath):
@@ -367,6 +398,7 @@ def report(path: str) -> dict[str, Any]:
         "serving": serving,
         "slo": slo,
         "fabric": fabric,
+        "autoscale": autoscale,
         "events": len(events),
         "bad_lines": bad,
         "complete": run_end is not None,
@@ -633,6 +665,26 @@ def render_human(rep: dict[str, Any]) -> str:
                 f"{t.get('retries', 0)} retried, "
                 f"{t.get('failed', 0)} dropped, "
                 f"{t.get('double_served', 0)} double-served"
+            )
+    if rep.get("autoscale"):
+        a = rep["autoscale"]
+        lines.append(
+            f"autoscale: {a['actions']} action(s) ({a['ups']} up / "
+            f"{a['downs']} down), {a['flaps']} flap(s), "
+            f"{a['errors']} error(s), {a['scrape_errors']} scrape error(s)"
+        )
+        for d in a["timeline"]:
+            inputs = ", ".join(
+                f"{k}={d[k]}"
+                for k in ("burn_availability", "burn_latency",
+                          "queue_p99_ms", "rate_per_s")
+                if d.get(k) is not None
+            )
+            lines.append(
+                f"  {d.get('action')} at +{d.get('t_rel')}s "
+                f"[{d.get('reason')}]: {d.get('replicas_before')}->"
+                f"{d.get('replicas_after')} replica(s)"
+                + (f" ({inputs})" if inputs else "")
             )
     for key in ("retries", "chaos", "watchdog", "degraded", "exhausted",
                 "shrinks"):
