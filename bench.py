@@ -962,6 +962,8 @@ def measure_serve_fabric() -> dict:
     overhead) — the fleet buys fault isolation there, not throughput;
     the >=3x scaling claim needs cores (recorded via ``cpus``)."""
     import shutil
+    import threading
+    import urllib.request
 
     from page_rank_and_tfidf_using_apache_spark_tpu import obs
     from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import (
@@ -1063,6 +1065,111 @@ def measure_serve_fabric() -> dict:
         stats["scale_downs"] = int(audit.get("scale_downs", 0))
         return fed, stats
 
+    def _roll_arm(index_dir: str) -> dict:
+        """Drain-handoff probe (ISSUE 20): a rolling restart under a
+        closed-loop load thread.  With the socket handoff carrying the
+        roll, retries attributed to the roll window must be ZERO — the
+        number trace_diff gates as an invariant."""
+        cfg = fb.FabricConfig(
+            replicas=2, poll_s=0.2, health_period_s=0.3,
+            retry_limit=120, retry_pause_s=0.1, grace_s=10.0,
+        )
+        with fb.ServingFabric(index_dir, cfg) as fab:
+            for q in queries[:4]:
+                fab.query(q)
+            stop_evt = threading.Event()
+
+            def load():
+                i = 0
+                while not stop_evt.is_set():
+                    fab.query(queries[i % len(queries)])
+                    i += 1
+
+            t = threading.Thread(target=load, daemon=True,
+                                 name="bench-roll-load")
+            t.start()
+            try:
+                fab.rolling_restart(timeout=60.0)
+            finally:
+                stop_evt.set()
+                t.join(10.0)
+            audit = fab.audit()
+        return {"roll_retries": int(audit["roll_retries"]),
+                "rolled": int(audit["rolled"]),
+                "dropped": int(audit["dropped"]),
+                "double_served": int(audit["double_served"])}
+
+    def _cache_arm(index_dir: str) -> dict:
+        """Sharded-cache A/B (ISSUE 20): the SAME Zipf-skewed stream
+        driven round-robin DIRECTLY at the replica /query endpoints
+        (every replica sees every hot key — the worst case for isolated
+        per-replica LRUs), with LRUs sized well below the key set.  Arm
+        A is the PR-17 fleet (peer_cache off), arm B the sharded cache;
+        the fleet-wide execution count measures duplicate computes and
+        every response is checked byte-equal across paths."""
+        stream_rng = np.random.default_rng(20)
+        ranks = np.arange(1, len(queries) + 1, dtype=np.float64)  # graftlint: disable=dtype-drift (host-only Zipf weight math for rng.choice; never dispatched)
+        weights = 1.0 / ranks ** 1.1
+        weights /= weights.sum()
+        stream = stream_rng.choice(len(queries), size=240, p=weights)
+
+        def drive(peer_cache: bool) -> dict:
+            cfg = fb.FabricConfig(
+                replicas=n, poll_s=0.2, health_period_s=0.3,
+                retry_limit=120, retry_pause_s=0.1, grace_s=10.0,
+                peer_cache=peer_cache, cache_size=8,
+            )
+            served: dict[int, list] = {}
+            with fb.ServingFabric(index_dir, cfg) as fab:
+                ports = [fab._ports[i] for i in sorted(fab._ports)]
+                for j, qi in enumerate(stream):
+                    doc = json.dumps({
+                        "rid": f"cache-{int(peer_cache)}-{j}",
+                        "terms": queries[qi], "ranker": "tfidf",
+                    }).encode()
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{ports[j % len(ports)]}/query",
+                        data=doc, method="POST",
+                        headers={"Content-Type": "application/json"})
+                    with urllib.request.urlopen(req, timeout=10.0) as r:
+                        out = json.loads(r.read())
+                    key = int(qi)
+                    pair = [out["scores"], out["docs"]]
+                    # byte-equality across every serve path (local
+                    # compute, local LRU, peer peek, filled owner)
+                    if served.setdefault(key, pair) != pair:
+                        raise AssertionError(
+                            f"divergent bytes for query {key}")
+                sts = [s for s in fab.statuses() if s is not None]
+                # computes, not serves: "executions" counts every
+                # first-time rid INCLUDING peer-hit serves (which never
+                # touch the dispatch queue), so the A/B signal lives in
+                # the server-level requests − cache_hits — submits that
+                # actually reached a dispatch
+                computes = sum(int(s.get("requests") or 0)
+                               - int(s.get("cache_hits") or 0)
+                               for s in sts)
+                hits = sum(int(s.get("peer_hits") or 0) for s in sts)
+                misses = sum(int(s.get("peer_misses") or 0) for s in sts)
+                tos = sum(int(s.get("peek_timeouts") or 0) for s in sts)
+            attempts = hits + misses + tos
+            return {"computes": computes, "peer_hits": hits,
+                    "peer_hit_rate": (round(hits / attempts, 4)
+                                      if attempts else None)}
+
+        a = drive(False)
+        b = drive(True)
+        return {
+            "computes_local_only": a["computes"],
+            "computes_sharded": b["computes"],
+            "peer_hit_rate": b["peer_hit_rate"],
+            # duplicate-compute reduction, the number the sharded cache
+            # exists to buy: >1 means fewer fleet-wide computes for
+            # the SAME skewed stream and byte-identical answers
+            "speedup": (round(a["computes"] / b["computes"], 3)
+                        if b["computes"] else None),
+        }
+
     tmp = tempfile.mkdtemp(prefix="bench_fabric_")
     try:
         out = run_tfidf(docs, scfg)
@@ -1077,6 +1184,14 @@ def measure_serve_fabric() -> dict:
                 fed, scale = _fed_arm(tmp)
             except Exception:  # noqa: BLE001 — federation probe is additive:
                 fed, scale = None, None  # null keys, fabric numbers survive
+            try:
+                roll = _roll_arm(tmp)
+            except Exception:  # noqa: BLE001 — additive probe, null keys
+                roll = None
+            try:
+                cache = _cache_arm(tmp)
+            except Exception:  # noqa: BLE001 — additive probe, null keys
+                cache = None
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     from page_rank_and_tfidf_using_apache_spark_tpu.analysis.protocol import (
@@ -1105,6 +1220,19 @@ def measure_serve_fabric() -> dict:
         # federation probe failed (the fabric numbers above survive).
         "fleet_federation": fed,
         "autoscale": scale,
+        # ISSUE 20: retries attributed to a handoff-carried rolling
+        # restart under closed-loop load (the zero-retry claim), and
+        # the sharded-cache A/B under the Zipf-skewed stream — the
+        # cross-replica hit rate and the duplicate-compute reduction
+        # vs the isolated-LRU fleet.  Null = the probe failed.
+        "fabric_roll_retries": (None if roll is None
+                                else roll["roll_retries"]),
+        "fabric_roll": roll,
+        "cache_peer_hit_rate": (None if cache is None
+                                else cache["peer_hit_rate"]),
+        "cache_speedup_skewed": (None if cache is None
+                                 else cache["speedup"]),
+        "cache_ab": cache,
     }
 
 
@@ -1898,9 +2026,22 @@ def _main(graph_cache: str) -> int:
     # fleet-p99 gates skip nulls but flag a round that LOST the keys.
     extra["fleet_federation"] = None
     extra["autoscale"] = None
+    # Always present (ISSUE 20 gate keys): roll-attributed retries (0
+    # when the drain handoff carried every roll), the cross-replica
+    # cache hit rate, and the skewed-stream duplicate-compute reduction
+    # — null = the fabric child (or that probe) failed this round.
+    extra["fabric_roll_retries"] = None
+    extra["cache_peer_hit_rate"] = None
+    extra["cache_speedup_skewed"] = None
     if fabric_out:
         extra["fleet_federation"] = fabric_out.get("fleet_federation")
         extra["autoscale"] = fabric_out.get("autoscale")
+        extra["fabric_roll_retries"] = fabric_out.get("fabric_roll_retries")
+        extra["fabric_roll"] = fabric_out.get("fabric_roll")
+        extra["cache_peer_hit_rate"] = fabric_out.get("cache_peer_hit_rate")
+        extra["cache_speedup_skewed"] = fabric_out.get(
+            "cache_speedup_skewed")
+        extra["cache_ab"] = fabric_out.get("cache_ab")
     # Always present so rounds are comparable: null = the sharded child
     # did not produce a number this round.
     extra["tfidf_sharded_tokens_per_sec"] = None

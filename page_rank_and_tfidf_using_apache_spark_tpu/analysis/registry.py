@@ -307,10 +307,42 @@ WIRE_SCHEMAS: tuple = (
      (),
      ("replica", "pid", "ready", "generation", "floor", "executions",
       "replays", "p50_ms", "p99_ms", "requests", "cache_hits",
-      "refreshes"),
+      "refreshes", "peer_hits", "peer_misses", "peek_timeouts", "fills",
+      "breaker_open", "peer_stores"),
      # identity + cache forensics: ops-facing, no router branch reads them
      ("replica", "pid", "cache_hits", "refreshes"),
      ((200, "success"),)),
+    # sharded-cache peer endpoints (ISSUE 20).  /cache/peek is a pure
+    # read (a miss is a SUCCESS with hit=false — the peeker computes
+    # locally; no rid, no side effects); /cache/fill is the idempotent
+    # owner write-back (rid-deduped exactly like /query, 503 below the
+    # floor so stale fills are refused retryably); /peers is the
+    # router's topology push after every membership change.
+    ("cache_peek", "POST", "/cache/peek",
+     f"{_PKG}/serving/fabric.py::_Replica.handle_cache_peek::req",
+     (f"{_PKG}/serving/fabric.py::_Replica._peek_owner::out",),
+     ("terms", "ranker"),
+     ("hit", "generation", "scores", "docs", "error"),
+     # error: the 400 body's diagnostic — the peeker acts on the CODE
+     ("error",),
+     ((200, "success"), (400, "terminal"))),
+    ("cache_fill", "POST", "/cache/fill",
+     f"{_PKG}/serving/fabric.py::_Replica.handle_cache_fill::req",
+     (f"{_PKG}/serving/fabric.py::_Replica._fill_owner::resp",),
+     ("rid", "terms", "ranker", "scores", "docs", "generation"),
+     ("stored", "replica", "generation", "error", "floor"),
+     # replica/generation: operator-facing echo; error/floor: the
+     # 400/503 bodies' diagnostics — the filler acts on the CODE
+     ("replica", "generation", "error", "floor"),
+     ((200, "success"), (400, "terminal"), (503, "retryable"))),
+    ("peers", "POST", "/peers",
+     f"{_PKG}/serving/fabric.py::_Replica.handle_peers::req",
+     (f"{_PKG}/serving/fabric.py::ServingFabric._push_peers",),
+     ("peers", "slots"),
+     ("ok", "peers", "error"),
+     # the push is fire-and-forget: the router acts on the CODE only
+     ("ok", "peers", "error"),
+     ((200, "success"), (400, "terminal"))),
     ("healthz", "GET", "/healthz",
      f"{_PKG}/obs/export.py::_dispatch",
      (),
@@ -404,6 +436,25 @@ METRIC_SCHEMAS: tuple = (
      (f"{_PKG}/resilience/executor.py", f"{_PKG}/obs/metrics.py")),
     ("respawns", "counter", "count", (f"{_PKG}/resilience/process.py",)),
     ("fabric_replica*_requests", "gauge", "requests",
+     (f"{_PKG}/serving/fabric.py",)),
+    # sharded-cache + drain-handoff instruments (ISSUE 20)
+    ("cache_peer_hits", "counter", "count",
+     (f"{_PKG}/serving/fabric.py",)),
+    ("cache_peer_misses", "counter", "count",
+     (f"{_PKG}/serving/fabric.py",)),
+    ("cache_peek_timeouts", "counter", "count",
+     (f"{_PKG}/serving/fabric.py",)),
+    ("cache_fills", "counter", "count",
+     (f"{_PKG}/serving/fabric.py",)),
+    ("cache_fill_errors", "counter", "count",
+     (f"{_PKG}/serving/fabric.py",)),
+    ("cache_breaker_transitions", "counter", "count",
+     (f"{_PKG}/serving/fabric.py",)),
+    ("cache_peek_s", "histogram", "seconds",
+     (f"{_PKG}/serving/fabric.py",)),
+    ("fabric_drain_s", "histogram", "seconds",
+     (f"{_PKG}/serving/fabric.py",)),
+    ("fabric_handoff_s", "histogram", "seconds",
      (f"{_PKG}/serving/fabric.py",)),
     ("segment_commits", "counter", "count",
      (f"{_PKG}/serving/segments.py",)),

@@ -35,6 +35,8 @@ from __future__ import annotations
 
 import json
 import os
+import selectors
+import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -112,6 +114,51 @@ def _make_handler(hub: MetricsHub, *, routes=None, ready=None):
     return Handler
 
 
+def reuse_port_supported() -> bool:
+    """Whether this platform exposes ``SO_REUSEPORT`` — the kernel-level
+    listener-group steering the fabric's drain handoff rides on.  Where
+    it is missing (some non-Linux platforms) callers fall back to the
+    retry-carried roll."""
+    return hasattr(socket, "SO_REUSEPORT")
+
+
+class _ReusePortHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that joins an ``SO_REUSEPORT`` listener group
+    before binding: a successor process can bind the SAME port while the
+    predecessor still serves, and the kernel steers each new connection
+    to exactly one of them — the zero-downtime drain-handoff transport
+    (serving/fabric.py rolling_restart)."""
+
+    def server_bind(self) -> None:
+        if reuse_port_supported():
+            self.socket.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        super().server_bind()
+
+    def serve_forever(self, poll_interval: float = 0.5) -> None:
+        """socketserver's loop checks the shutdown flag BEFORE accepting
+        a ready connection, so a handshake already queued in this
+        listener's backlog when shutdown() lands is abandoned and RST on
+        close — in a reuseport group that is one spurious client reset
+        per drain, i.e. one roll-attributed retry.  Reorder to
+        accept-then-check and sweep the backlog dry with a zero-timeout
+        select before exiting, so the socket closes empty and the drain
+        truly hands every steered connection off."""
+        self._BaseServer__is_shut_down.clear()  # graftlint: disable=unsynced-thread-state (threading.Event is internally locked; stdlib serve_forever mutates the same pair lock-free)
+        try:
+            with selectors.SelectSelector() as selector:
+                selector.register(self, selectors.EVENT_READ)
+                while not self._BaseServer__shutdown_request:
+                    if selector.select(poll_interval):
+                        self._handle_request_noblock()
+                    self.service_actions()
+                while selector.select(0):
+                    self._handle_request_noblock()
+        finally:
+            self._BaseServer__shutdown_request = False  # graftlint: disable=unsynced-thread-state (single-writer handshake flag; shutdown() only ever sets it True and blocks on the event below)
+            self._BaseServer__is_shut_down.set()  # graftlint: disable=unsynced-thread-state (threading.Event is internally locked)
+
+
 class MetricsExporter:
     """Background HTTP server publishing one hub's live snapshot.
 
@@ -122,23 +169,33 @@ class MetricsExporter:
     audit surface is the hub, not the exporter)."""
 
     def __init__(self, hub: MetricsHub, *, port: int = 0,
-                 host: str = "127.0.0.1", routes=None, ready=None):
+                 host: str = "127.0.0.1", routes=None, ready=None,
+                 reuse_port: bool = False, drain: bool = False):
         self.hub = hub
         self.host = host
         self.port = int(port)
         self.routes = routes
         self.ready = ready
+        # reuse_port: bind into an SO_REUSEPORT listener group so a
+        # successor can share the port during a drain handoff.  drain:
+        # handler threads become non-daemon and stop() blocks until every
+        # in-flight request has been answered (ThreadingMixIn's
+        # block_on_close join) — the predecessor side of the handoff.
+        self.reuse_port = bool(reuse_port)
+        self.drain = bool(drain)
         self._srv: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     def start(self) -> "MetricsExporter":
         if self._srv is not None:
             return self
-        self._srv = ThreadingHTTPServer(
+        server_cls = (_ReusePortHTTPServer if self.reuse_port
+                      else ThreadingHTTPServer)
+        self._srv = server_cls(
             (self.host, self.port),
             _make_handler(self.hub, routes=self.routes, ready=self.ready),
         )
-        self._srv.daemon_threads = True
+        self._srv.daemon_threads = not self.drain
         self.port = int(self._srv.server_address[1])
         self._thread = threading.Thread(
             target=self._srv.serve_forever, name="graft-metrics-http",

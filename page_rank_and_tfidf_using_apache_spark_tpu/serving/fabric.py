@@ -52,15 +52,44 @@ ROADMAP's autoscaling follow-on on it:
   ``tools/trace_report.py`` renders the timeline and ``tools/trace_diff.py``
   gates on flap count.
 
+ISSUE 20 adds two cooperating robustness layers:
+
+- **Drain by handoff, not retry**: with ``FabricConfig.handoff`` (default
+  on where the platform has ``SO_REUSEPORT``) every replica id owns a
+  FIXED port reserved by the router, and replica listeners join an
+  ``SO_REUSEPORT`` group on it.  :meth:`ServingFabric.rolling_restart`
+  spawns the successor FIRST (``--ready-at-floor``: its handshake only
+  prints once it serves >= the committed floor on the shared port), then
+  SIGTERMs the predecessor, which stops accepting, drains its in-flight
+  requests to completion (non-daemon handler threads joined on close)
+  and exits — the kernel steers new connections to the successor the
+  whole time, so a roll under load needs ZERO sibling retries (the
+  ``roll_retries`` audit key pins this).  The suspect/retry machinery
+  stays as the UNPLANNED-failure path.
+- **Sharded distributed result cache**: the consistent-hash ring owner
+  of an affinity key is its cache authority.  A non-owner replica that
+  misses its local LRU issues a bounded-deadline ``POST /cache/peek`` to
+  the owner before computing, and fills the owner back with an
+  idempotent-by-rid ``POST /cache/fill`` after computing.  Every peer
+  interaction sits behind a per-peer circuit breaker (trip on
+  consecutive timeouts, half-open probe; ``GRAFT_CACHE_*`` knobs) and
+  falls back to local compute, so a slow/partitioned/dead peer can never
+  add more than the peek deadline to p99 — graceful degradation to
+  exactly the PR-17 local-LRU behavior.  The router broadcasts the
+  id→port map over ``POST /peers`` on every membership change.
+
 Process-level chaos rides the deterministic ``GRAFT_CHAOS`` grammar:
 ``replica_query:proc_kill@N`` SIGKILLs a replica mid-query (injected in
 THAT replica's environment via ``FabricConfig.replica_chaos``),
 ``replica_swap:proc_kill@1`` kills it mid-hot-swap, and
 ``fabric_route:net_partition@N`` / ``fabric_route:net_hang@N:ms`` fault
-the router→replica hop.  All three sites are guarded through
+the router→replica hop.  ISSUE 20 adds ``drain_handoff`` (the successor
+spawn of a handoff roll), ``cache_peek`` and ``cache_fill`` (the peer
+cache hops — ``net_partition``/``net_hang`` model a partitioned or slow
+peer).  All sites are guarded through
 ``resilience.executor.attempt_once`` — one chaos-hooked attempt each;
-the recovery loop (sibling retry, supervisor respawn) lives HERE, which
-is exactly what attempt_once is for.
+the recovery loop (sibling retry, supervisor respawn, breaker + local
+fallback) lives HERE, which is exactly what attempt_once is for.
 """
 
 from __future__ import annotations
@@ -72,7 +101,9 @@ import hashlib
 import itertools
 import json
 import os
+import queue
 import signal
+import socket
 import sys
 import tempfile
 import threading
@@ -102,13 +133,26 @@ from page_rank_and_tfidf_using_apache_spark_tpu.utils.metrics import percentile
 # Guarded chaos/retry sites of the fabric (tools/chaos.sh + tests name
 # them; tier-4 chaos-coverage-drift audits that every site stays covered):
 # the router→replica hop, the replica's hot-swap, the replica's query
-# execution.
+# execution, the handoff successor spawn, and the two peer-cache hops.
 ROUTE_SITE = "fabric_route"
 SWAP_SITE = "replica_swap"
 QUERY_SITE = "replica_query"
+DRAIN_SITE = "drain_handoff"
+PEEK_SITE = "cache_peek"
+FILL_SITE = "cache_fill"
 
 # The fleet's committed generation, next to LATEST in the index dir.
 FLOOR_FILE = "FABRIC_FLOOR"
+
+
+def _peer_knobs() -> "tuple[float, int, float]":
+    """The declared peer-cache knobs (utils/config.py GRAFT_ENV_KNOBS +
+    README env-knob table): peek deadline, breaker trip count, breaker
+    half-open probe period."""
+    deadline = float(os.environ.get("GRAFT_CACHE_PEEK_DEADLINE_S") or 0.25)
+    trip = int(os.environ.get("GRAFT_CACHE_BREAKER_TRIP") or 3)
+    probe = float(os.environ.get("GRAFT_CACHE_BREAKER_PROBE_S") or 2.0)
+    return deadline, trip, probe
 
 
 class FabricExhausted(RuntimeError):
@@ -181,6 +225,17 @@ class FabricConfig:
     # replicas' default hub window — merge raises on mismatch)
     latency_slo_s: float | None = None  # fleet latency budget (None: off)
     availability_target: float | None = None  # fleet availability budget
+    handoff: bool = True  # rolling restarts drain by SO_REUSEPORT socket
+    # handoff (successor first on the SAME port, predecessor drains) —
+    # zero roll-attributed retries; auto-off where the platform lacks
+    # SO_REUSEPORT, falling back to the PR-17 retry-carried roll
+    peer_cache: bool = True  # owner-routed sharded result cache: the
+    # router pushes the id→port map (POST /peers) so replicas peek the
+    # ring owner before computing and fill it back after; off = the
+    # PR-17 local-only LRUs (the bench A/B arm)
+    cache_size: int | None = None  # per-replica result-LRU size override
+    # (None: the replica's ServeConfig default; the bench's skewed A/B
+    # shrinks it to make fleet-wide duplicate computes measurable)
 
     @staticmethod
     def from_env(**overrides) -> "FabricConfig":
@@ -279,6 +334,46 @@ def affinity_key(terms: Sequence[str], ranker: str) -> str:
     return ranker + "|" + " ".join(sorted(set(terms)))
 
 
+# --------------------------------------------------------------- breaker
+
+
+class _Breaker:
+    """Per-peer circuit breaker for the cache peek/fill hops (state is
+    guarded by the owning replica's ``_peer_lock``; this class holds no
+    lock of its own).
+
+    closed → (``trip`` consecutive failures) → open → (``probe_s``
+    elapsed) → half_open: exactly ONE probe flies, success closes,
+    failure re-opens and re-arms the probe timer.  While open (or while
+    the half-open probe is outstanding) ``allow`` answers False and the
+    caller computes locally — a dead peer costs nothing per request."""
+
+    def __init__(self, trip: int, probe_s: float):
+        self.trip = max(1, int(trip))
+        self.probe_s = float(probe_s)
+        self.failures = 0
+        self.state = "closed"
+        self.opened_t = 0.0
+
+    def allow(self, now: float) -> bool:
+        if self.state == "closed":
+            return True
+        if self.state == "open" and now - self.opened_t >= self.probe_s:
+            self.state = "half_open"  # this caller IS the probe
+            return True
+        return False
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.state = "closed"
+
+    def record_failure(self, now: float) -> None:
+        self.failures += 1
+        if self.state == "half_open" or self.failures >= self.trip:
+            self.state = "open"
+            self.opened_t = now
+
+
 # --------------------------------------------------------------- replica
 
 
@@ -296,13 +391,14 @@ class _Replica:
 
     def __init__(self, index_dir: str, *, replica_id: int, top_k: int,
                  max_batch: int | None, scoring: str, poll_s: float,
-                 rid_cache: int = 4096):
+                 rid_cache: int = 4096, cache_size: "int | None" = None):
         self.index_dir = index_dir
         self.replica_id = replica_id
         self.top_k = top_k
         self.max_batch = max_batch
         self.scoring = scoring
         self.poll_s = poll_s
+        self.cache_size = cache_size
         self.srv = None  # TfidfServer once a servable generation loaded
         self.generation: int | None = None
         self.floor = read_floor(index_dir)
@@ -317,6 +413,22 @@ class _Replica:
         self._lat: collections.deque = collections.deque(maxlen=512)
         self._executions = 0
         self._replays = 0
+        # Sharded-cache peer state (ISSUE 20), all under its OWN lock so
+        # peer bookkeeping never contends with the serving hot path:
+        # id→port map + authority ring pushed by the router (POST
+        # /peers), one circuit breaker per peer, and the peer tallies.
+        self._peer_lock = threading.Lock()
+        self._peers: dict[int, int] = {}
+        self._peer_ring: "_Ring | None" = None
+        self._breakers: dict[int, _Breaker] = {}
+        self._peer_stats: collections.Counter = collections.Counter()
+        (self._peek_deadline_s, self._breaker_trip,
+         self._breaker_probe_s) = _peer_knobs()
+        # write-backs to the owner are asynchronous and best-effort: a
+        # bounded queue drained by fabric-peer-fill; full = drop (the
+        # owner just stays cold for that key)
+        self._fill_q: "queue.Queue" = queue.Queue(maxsize=256)
+        self._fill_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._poll_thread: threading.Thread | None = None
 
@@ -328,13 +440,24 @@ class _Replica:
             target=self._poll_loop, name="fabric-replica-poll", daemon=True
         )
         self._poll_thread.start()
+        self._fill_thread = threading.Thread(
+            target=self._fill_loop, name="fabric-peer-fill", daemon=True
+        )
+        self._fill_thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        try:
+            self._fill_q.put_nowait(None)  # fill-loop shutdown sentinel
+        except queue.Full:
+            pass  # daemon thread; pending fills are best-effort anyway
         if self._poll_thread is not None:
             self._poll_thread.join(timeout=10.0)
             self._poll_thread = None
+        if self._fill_thread is not None:
+            self._fill_thread.join(timeout=5.0)
+            self._fill_thread = None
         if self.srv is not None:
             self.srv.stop()
 
@@ -354,9 +477,11 @@ class _Replica:
             tuned_config,
         )
 
-        return tuned_config(ServeConfig, load_tuned_profile(),
-                            top_k=self.top_k, max_batch=self.max_batch,
+        kwargs: dict = dict(top_k=self.top_k, max_batch=self.max_batch,
                             scoring=self.scoring)
+        if self.cache_size is not None:
+            kwargs["cache_size"] = self.cache_size
+        return tuned_config(ServeConfig, load_tuned_profile(), **kwargs)
 
     def _try_load(self) -> None:
         """Initial load — refused outright while the newest committed
@@ -422,6 +547,203 @@ class _Replica:
                 obs.emit("fabric_swap_error", replica=self.replica_id,
                          error=f"{type(exc).__name__}: {exc}"[:200])
 
+    # ------------------------------------------------------ sharded cache
+
+    def _cache_owner(self, terms, ranker: str) -> "int | None":
+        """The ring authority for this query's affinity key, or None when
+        no peer topology has been pushed (single replica / peer cache
+        off) — the caller then behaves exactly like PR-17 local-only."""
+        key = affinity_key(terms, ranker)
+        with self._peer_lock:
+            ring = self._peer_ring
+            if ring is None:
+                return None
+            route = ring.route(key)
+        return route[0] if route else None
+
+    def _peer_post(self, port: int, path: str, doc: dict,
+                   timeout: float) -> dict:
+        """Blocking JSON POST to a sibling replica on localhost.  Lives
+        outside the reader methods so their wire contract stays exactly
+        one request-shaped dict literal each (tier 6)."""
+        data = json.dumps(doc).encode("utf-8")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method="POST")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=timeout) as fh:
+            return json.loads(fh.read().decode("utf-8"))
+
+    def _breaker_for(self, owner: int) -> "_Breaker | None":
+        with self._peer_lock:
+            br = self._breakers.get(owner)
+            if br is None:
+                return None
+            before = br.state
+            allowed = br.allow(time.monotonic())
+            if br.state != before:
+                self._emit_breaker(owner, before, br.state)
+            return br if allowed else None
+
+    def _emit_breaker(self, owner: int, old: str, new: str) -> None:
+        """Caller holds ``_peer_lock``."""
+        self._peer_stats["breaker_transitions"] += 1
+        if new == "open":
+            self._peer_stats["breaker_trips"] += 1
+        obs.counter("cache_breaker_transitions")
+        obs.emit("cache_breaker", replica=self.replica_id, peer=owner,
+                 old=old, new=new)
+
+    def _record_peer_outcome(self, owner: int, *, ok: bool) -> None:
+        with self._peer_lock:
+            br = self._breakers.get(owner)
+            if br is None:
+                return
+            before = br.state
+            if ok:
+                br.record_success()
+            else:
+                br.record_failure(time.monotonic())
+            if br.state != before:
+                self._emit_breaker(owner, before, br.state)
+
+    def _peek_owner(self, owner: int, terms, ranker: str):
+        """Bounded-deadline cache peek at the ring authority.
+
+        The HTTP round-trip runs on a disposable worker thread joined for
+        at most the peek deadline: a hung/partitioned peer (chaos
+        ``net_hang``) costs this request exactly the deadline, never
+        more, and the abandoned daemon worker is reaped when its socket
+        timeout fires.  Any failure → breaker bookkeeping + None (caller
+        computes locally — graceful degradation to PR-17 behavior)."""
+        if self._breaker_for(owner) is None:
+            with self._peer_lock:
+                self._peer_stats["peeks_skipped_open"] += 1
+            return None
+        with self._peer_lock:
+            port = self._peers.get(owner)
+        if port is None:
+            return None
+        doc = {"terms": list(terms), "ranker": ranker}
+        cell: list = []
+
+        def _worker() -> None:
+            try:
+                rx.attempt_once(
+                    lambda: cell.append(
+                        self._peer_post(port, "/cache/peek", doc,
+                                        self._peek_deadline_s)),
+                    site=PEEK_SITE,
+                )
+            except Exception as exc:  # noqa: BLE001 — captured for outcome
+                cell.append(exc)
+
+        t0 = time.perf_counter()
+        worker = threading.Thread(target=_worker, name="fabric-peer-peek",
+                                  daemon=True)
+        worker.start()
+        worker.join(self._peek_deadline_s)
+        obs.histogram("cache_peek_s", time.perf_counter() - t0)
+        out = cell[0] if cell else None
+        if out is None or isinstance(out, Exception):
+            # timeout, refused connection, chaos net_partition/net_hang —
+            # all count against the peer's breaker
+            obs.counter("cache_peek_timeouts")
+            with self._peer_lock:
+                self._peer_stats["peek_timeouts"] += 1
+            self._record_peer_outcome(owner, ok=False)
+            return None
+        self._record_peer_outcome(owner, ok=True)
+        with self._lock:
+            gen = self.generation
+        if out.get("hit") and out.get("generation") == gen:
+            obs.counter("cache_peer_hits")
+            with self._peer_lock:
+                self._peer_stats["peer_hits"] += 1
+            return ([float(s) for s in out["scores"]],
+                    [int(d) for d in out["docs"]])
+        obs.counter("cache_peer_misses")
+        with self._peer_lock:
+            self._peer_stats["peer_misses"] += 1
+        return None
+
+    def _enqueue_fill(self, owner: int, rid: str, terms, ranker: str,
+                      scores, docs) -> None:
+        with self._lock:
+            gen = self.generation
+        try:
+            self._fill_q.put_nowait(
+                (owner, rid, list(terms), ranker, scores, docs, gen))
+        except queue.Full:
+            with self._peer_lock:
+                self._peer_stats["fills_dropped"] += 1
+
+    def _fill_loop(self) -> None:
+        while True:
+            item = self._fill_q.get()
+            if item is None or self._stop.is_set():
+                return
+            try:
+                self._fill_owner(*item)
+            except Exception:  # noqa: BLE001 — fills are best-effort
+                obs.counter("cache_fill_errors")
+                with self._peer_lock:
+                    self._peer_stats["fill_errors"] += 1
+
+    def _fill_owner(self, owner: int, rid: str, terms, ranker: str,
+                    scores, docs, generation) -> None:
+        """One asynchronous owner write-back (idempotent by rid)."""
+        if self._breaker_for(owner) is None:
+            with self._peer_lock:
+                self._peer_stats["fills_skipped_open"] += 1
+            return
+        with self._peer_lock:
+            port = self._peers.get(owner)
+        if port is None:
+            return
+        doc = {"rid": rid, "terms": terms, "ranker": ranker,
+               "scores": scores, "docs": docs, "generation": generation}
+        try:
+            resp = rx.attempt_once(
+                lambda: self._peer_post(port, "/cache/fill", doc,
+                                        self._peek_deadline_s),
+                site=FILL_SITE,
+            )
+        except urllib.error.HTTPError:
+            # typed rejection (e.g. 503 below-floor): the peer answered —
+            # breaker stays healthy, the owner just stays cold
+            self._record_peer_outcome(owner, ok=True)
+            return
+        except Exception:  # noqa: BLE001 — timeout/partition
+            obs.counter("cache_fill_errors")
+            with self._peer_lock:
+                self._peer_stats["fill_errors"] += 1
+            self._record_peer_outcome(owner, ok=False)
+            return
+        self._record_peer_outcome(owner, ok=True)
+        if resp.get("stored"):
+            obs.counter("cache_fills")
+            with self._peer_lock:
+                self._peer_stats["fills"] += 1
+
+    def configure_peers(self, peers: "dict[int, int]", *,
+                        slots: int = 64) -> None:
+        """Install the fleet topology pushed by the router: id→port map
+        and the cache-authority ring (all replica ids, self included, so
+        every member routes a key to the SAME owner).  Existing breaker
+        state survives a push — a roll must not reset trip history."""
+        others = {i: p for i, p in peers.items() if i != self.replica_id}
+        ids = sorted(set(peers) | {self.replica_id})
+        with self._peer_lock:
+            self._peers = others
+            self._peer_ring = _Ring(ids, slots=slots) if len(ids) > 1 else None
+            self._breakers = {
+                i: self._breakers.get(i)
+                or _Breaker(self._breaker_trip, self._breaker_probe_s)
+                for i in others
+            }
+        obs.emit("cache_peers", replica=self.replica_id,
+                 peers=sorted(others), slots=slots)
+
     # ----------------------------------------------------------- HTTP API
 
     def handle_query(self, body: bytes) -> tuple[int, str, str]:
@@ -455,27 +777,54 @@ class _Replica:
                     json.dumps({"error": "replica below generation floor",
                                 "generation": gen, "floor": floor}))
         t0 = time.perf_counter()
-        try:
-            # ONE chaos-hooked execution (proc_kill here is the
-            # replica-SIGKILL-mid-query scenario; the router's sibling
-            # retry owns recovery)
-            scores, docs = rx.attempt_once(
-                lambda: self.srv.query(terms, ranker=ranker),
-                site=QUERY_SITE,
-            )
-        except ServerShutdown as exc:
-            return (503, "application/json",
-                    json.dumps({"error": f"shutdown: {exc}"}))
-        except ValueError as exc:  # unknown ranker / no BM25 weights
-            return (400, "application/json", json.dumps({"error": str(exc)}))
+        # Sharded-cache fast path (ISSUE 20): when another replica is the
+        # ring authority for this key, consult the local LRU, then peek
+        # the owner under a bounded deadline — and only then compute.
+        # Every branch serves the SAME values (JSON round-trip exact), so
+        # local hit / peer hit / fallback compute are byte-equal.
+        owner = self._cache_owner(terms, ranker)
+        served: "tuple[list, list] | None" = None
+        if owner is not None and owner != self.replica_id:
+            try:
+                local = self.srv.cache_lookup(terms, ranker=ranker)
+            except Exception:  # noqa: BLE001 — lookup is best-effort
+                local = None
+            if local is not None:
+                served = ([float(s) for s in local[0]],
+                          [int(d) for d in local[1]])
+                with self._peer_lock:
+                    self._peer_stats["nonowner_local_hits"] += 1
+            else:
+                served = self._peek_owner(owner, terms, ranker)
+        if served is None:
+            try:
+                # ONE chaos-hooked execution (proc_kill here is the
+                # replica-SIGKILL-mid-query scenario; the router's sibling
+                # retry owns recovery)
+                scores, docs = rx.attempt_once(
+                    lambda: self.srv.query(terms, ranker=ranker),
+                    site=QUERY_SITE,
+                )
+            except ServerShutdown as exc:
+                return (503, "application/json",
+                        json.dumps({"error": f"shutdown: {exc}"}))
+            except ValueError as exc:  # unknown ranker / no BM25 weights
+                return (400, "application/json",
+                        json.dumps({"error": str(exc)}))
+            served = ([float(s) for s in scores], [int(d) for d in docs])
+            if owner is not None and owner != self.replica_id:
+                # fill the authority back asynchronously (idempotent by
+                # rid — a router re-dispatch fills once)
+                self._enqueue_fill(owner, rid, terms, ranker,
+                                   served[0], served[1])
         with self._lock:
             gen = self.generation
         resp = (200, "application/json", json.dumps({
             "rid": rid,
             "replica": self.replica_id,
             "generation": gen,
-            "scores": [float(s) for s in scores],
-            "docs": [int(d) for d in docs],
+            "scores": served[0],
+            "docs": served[1],
         }))
         with self._lock:
             self._executions += 1
@@ -490,6 +839,10 @@ class _Replica:
             gen, floor = self.generation, self.floor
             executions, replays = self._executions, self._replays
             p50, p99 = _percentiles_ms(self._lat)
+        with self._peer_lock:
+            peer = dict(self._peer_stats)
+            breaker_open = sum(
+                1 for b in self._breakers.values() if b.state != "closed")
         stats = dict(self.srv.stats()) if self.srv is not None else {}
         return (200, "application/json", json.dumps({
             "replica": self.replica_id,
@@ -504,7 +857,112 @@ class _Replica:
             "requests": int(stats.get("requests", 0)),
             "cache_hits": int(stats.get("cache_hits", 0)),
             "refreshes": int(stats.get("refreshes", 0)),
+            "peer_hits": int(peer.get("peer_hits", 0)),
+            "peer_misses": int(peer.get("peer_misses", 0)),
+            "peek_timeouts": int(peer.get("peek_timeouts", 0)),
+            "fills": int(peer.get("fills", 0)),
+            "breaker_open": breaker_open,
+            "peer_stores": int(stats.get("peer_stores", 0)),
         }))
+
+    def handle_cache_peek(self, body: bytes) -> tuple[int, str, str]:
+        """``POST /cache/peek`` — the cache-authority read path.  A pure
+        lookup: a miss is a successful 200 with ``hit: false`` (the
+        peeker falls back to computing), never an error; no side effects,
+        so no rid and no idempotency machinery."""
+        try:
+            req = json.loads(body.decode("utf-8"))
+            terms = [str(t) for t in req["terms"]]
+            ranker = str(req.get("ranker", "tfidf"))
+        except (ValueError, KeyError, UnicodeDecodeError,
+                TypeError, AttributeError) as exc:
+            return (400, "application/json",
+                    json.dumps({"error": f"bad request: {exc}"}))
+        with self._lock:
+            gen = self.generation
+        hit = None
+        if self.srv is not None and self.ready():
+            try:
+                hit = self.srv.cache_lookup(terms, ranker=ranker)
+            except Exception:  # noqa: BLE001 — lookup is best-effort
+                hit = None
+        if hit is None:
+            return (200, "application/json",
+                    json.dumps({"hit": False, "generation": gen}))
+        return (200, "application/json", json.dumps({
+            "hit": True,
+            "generation": gen,
+            "scores": [float(s) for s in hit[0]],
+            "docs": [int(d) for d in hit[1]],
+        }))
+
+    def handle_cache_fill(self, body: bytes) -> tuple[int, str, str]:
+        """``POST /cache/fill`` — the cache-authority write-back,
+        idempotent by rid (a router re-dispatch of the originating query
+        re-fills at most once: the replayed rid returns the SAME bytes
+        without touching the cache again)."""
+        try:
+            req = json.loads(body.decode("utf-8"))
+            rid = str(req["rid"])
+            terms = [str(t) for t in req["terms"]]
+            scores = [float(s) for s in req["scores"]]
+            docs = [int(d) for d in req["docs"]]
+            gen_in = int(req["generation"])
+            ranker = str(req.get("ranker", "tfidf"))
+        except (ValueError, KeyError, UnicodeDecodeError,
+                TypeError, AttributeError) as exc:
+            return (400, "application/json",
+                    json.dumps({"error": f"bad request: {exc}"}))
+        fill_key = "fill:" + rid  # namespaced: never collides with /query
+        with self._lock:
+            cached = self._rid_cache.get(fill_key)
+            if cached is not None:
+                self._rid_cache.move_to_end(fill_key)
+                self._replays += 1
+        if cached is not None:
+            return cached
+        if not self.ready():
+            with self._lock:
+                gen, floor = self.generation, self.floor
+            return (503, "application/json",
+                    json.dumps({"error": "replica below generation floor",
+                                "generation": gen, "floor": floor}))
+        with self._lock:
+            gen = self.generation
+        stored = False
+        if gen_in == gen:
+            # only same-generation fills are authoritative: a straggler
+            # fill from before a hot-swap must not resurrect stale scores
+            try:
+                stored = bool(self.srv.cache_insert(
+                    terms, scores, docs, ranker=ranker))
+            except Exception:  # noqa: BLE001 — insert is best-effort
+                stored = False
+        resp = (200, "application/json", json.dumps({
+            "stored": stored,
+            "replica": self.replica_id,
+            "generation": gen,
+        }))
+        with self._lock:
+            self._rid_cache[fill_key] = resp
+            while len(self._rid_cache) > self._rid_cap:
+                self._rid_cache.popitem(last=False)
+        return resp
+
+    def handle_peers(self, body: bytes) -> tuple[int, str, str]:
+        """``POST /peers`` — router pushes the fleet topology (id→port)
+        after every membership change; idempotent by construction."""
+        try:
+            req = json.loads(body.decode("utf-8"))
+            peers = {int(k): int(v) for k, v in req["peers"].items()}
+            slots = int(req.get("slots", 64))
+        except (ValueError, KeyError, UnicodeDecodeError,
+                TypeError, AttributeError) as exc:
+            return (400, "application/json",
+                    json.dumps({"error": f"bad request: {exc}"}))
+        self.configure_peers(peers, slots=slots)
+        return (200, "application/json",
+                json.dumps({"ok": True, "peers": len(peers)}))
 
 
 def replica_main(argv: "list[str] | None" = None) -> int:
@@ -526,6 +984,16 @@ def replica_main(argv: "list[str] | None" = None) -> int:
     p.add_argument("--metrics-window-s", type=float, default=60.0)
     p.add_argument("--latency-slo-s", type=float, default=None)
     p.add_argument("--availability-target", type=float, default=None)
+    # --reuse-port: join the SO_REUSEPORT listener group on --port AND
+    # drain in-flight requests on SIGTERM — the predecessor/successor
+    # sides of the zero-downtime handoff (ISSUE 20).  --ready-at-floor
+    # defers the stdout handshake until ready(): the router's spawn()
+    # blocks on the handshake, so a handoff successor signals "healthy"
+    # through the SAME mechanism that already guards against leaked
+    # children.  --cache-size bounds the server LRU (bench A/B).
+    p.add_argument("--reuse-port", action="store_true")
+    p.add_argument("--ready-at-floor", action="store_true")
+    p.add_argument("--cache-size", type=int, default=None)
     args = p.parse_args(argv)
 
     stop = threading.Event()
@@ -538,7 +1006,8 @@ def replica_main(argv: "list[str] | None" = None) -> int:
     with obs.run(f"fabric-replica{args.replica_id}"):
         rep = _Replica(args.index, replica_id=args.replica_id,
                        top_k=args.top_k, max_batch=args.max_batch,
-                       scoring=args.scoring, poll_s=args.poll_s).start()
+                       scoring=args.scoring, poll_s=args.poll_s,
+                       cache_size=args.cache_size).start()
         # the replica's OWN hub, not the lazy process default: windowed
         # to the fleet's merge window and carrying the router-declared
         # SLO budgets, so what this replica exports is federable and its
@@ -551,9 +1020,18 @@ def replica_main(argv: "list[str] | None" = None) -> int:
         exporter = obs.export.MetricsExporter(
             hub, port=args.port,
             routes={("POST", "/query"): rep.handle_query,
-                    ("GET", "/status"): rep.handle_status},
+                    ("GET", "/status"): rep.handle_status,
+                    ("POST", "/cache/peek"): rep.handle_cache_peek,
+                    ("POST", "/cache/fill"): rep.handle_cache_fill,
+                    ("POST", "/peers"): rep.handle_peers},
             ready=rep.ready,
+            reuse_port=args.reuse_port, drain=args.reuse_port,
         ).start()
+        # handoff successor: hold the handshake until this process could
+        # actually serve — the router treats handshake == /healthz-green
+        # and only then SIGTERMs the predecessor
+        while args.ready_at_floor and not rep.ready() and not stop.is_set():
+            time.sleep(args.poll_s)
         print(json.dumps({"ready": True, "port": exporter.port,
                           "pid": os.getpid(),
                           "generation": rep.generation}), flush=True)
@@ -561,9 +1039,20 @@ def replica_main(argv: "list[str] | None" = None) -> int:
             stop.wait()
         finally:
             # graceful: stop accepting (HTTP down), then drain the server
-            # — still-pending futures fail typed (ServerShutdown), and
-            # the router re-dispatches them on a sibling
+            # — with --reuse-port the exporter BLOCKS here until every
+            # in-flight handler thread has answered (the predecessor side
+            # of the handoff: the kernel already steers new connections
+            # to the successor, so draining loses nothing); without it,
+            # still-pending futures fail typed (ServerShutdown) and the
+            # router re-dispatches them on a sibling
+            t_drain = time.perf_counter()
+            obs.emit("fabric_drain_begin", replica=args.replica_id,
+                     pid=os.getpid(), handoff=bool(args.reuse_port))
             exporter.stop()
+            drain_s = time.perf_counter() - t_drain
+            obs.histogram("fabric_drain_s", drain_s)
+            obs.emit("fabric_drain_done", replica=args.replica_id,
+                     pid=os.getpid(), drain_s=round(drain_s, 6))
             rep.stop()
             obs.bus().detach(sink)
     return 0
@@ -587,11 +1076,26 @@ class ServingFabric:
         self._next_id = cfg.replicas
         self._suspect: set[int] = set()
         self._restarting: set[int] = set()
+        # ids mid-drain-handoff: the supervisor must NOT respawn a
+        # predecessor that dies inside the handoff window (the swap
+        # would orphan the respawn — two listeners on one port), but
+        # unlike _restarting the id stays in routing rotation: the
+        # whole point of the handoff is that it never stops serving
+        self._handoff_ids: set[int] = set()
         self._down_since: dict[int, float] = {}
         self._ring = _Ring(range(cfg.replicas), cfg.ring_slots)
         self._lock = threading.Lock()  # membership/ports/suspects/audit/stats
         self._stats: collections.Counter = collections.Counter()
         self._audit: dict[str, int] = {}  # rid -> accepted deliveries
+        # Drain-handoff state (ISSUE 20): per-id "anchor" sockets — bound
+        # with SO_REUSEPORT but never listening — pin each replica's port
+        # across respawns and rolls so a successor can join the listener
+        # group on the SAME address while the predecessor drains.
+        # _roll_active > 0 while rolling_restart runs: retries taken in
+        # that window are roll-attributed (the handoff acceptance gate
+        # requires that count to stay 0).
+        self._anchors: dict[int, socket.socket] = {}
+        self._roll_active = 0
         self._rid_seq = itertools.count()
         self._rid_prefix = f"f{os.getpid()}-{int(time.time() * 1e3) & 0xFFFFFF}"
         self._stop = threading.Event()
@@ -611,15 +1115,51 @@ class ServingFabric:
 
     # ----------------------------------------------------------- lifecycle
 
+    def _handoff_enabled(self) -> bool:
+        """Drain handoff needs SO_REUSEPORT; without it (or with
+        cfg.handoff off) rolls fall back to the PR-17 retry-carried
+        path."""
+        return self.cfg.handoff and obs.export.reuse_port_supported()
+
+    def _fixed_port(self, i: int) -> int:
+        """The pinned port for replica ``i``, reserved by an anchor
+        socket that joins the SO_REUSEPORT group but never listens (so
+        the kernel steers zero connections to it).  Created on first use,
+        held until the id leaves the fleet — respawns and handoff
+        successors all bind the same address."""
+        with self._lock:
+            anchor = self._anchors.get(i)
+            if anchor is None:
+                anchor = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                anchor.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+                anchor.bind(("127.0.0.1", 0))
+                self._anchors[i] = anchor
+            return int(anchor.getsockname()[1])
+
+    def _close_anchor(self, i: int) -> None:
+        with self._lock:
+            anchor = self._anchors.pop(i, None)
+        if anchor is not None:
+            try:
+                anchor.close()
+            except OSError:
+                pass
+
     def _replica_argv(self, i: int) -> list[str]:
+        if self._handoff_enabled():
+            port_args = ["--port", str(self._fixed_port(i)), "--reuse-port"]
+        else:
+            port_args = ["--port", "0"]
         argv = [sys.executable, "-m",
                 "page_rank_and_tfidf_using_apache_spark_tpu.serving.fabric",
                 "--replica", self.index_dir,
                 "--replica-id", str(i),
-                "--port", "0",
+                *port_args,
                 "--top-k", str(self.cfg.top_k),
                 "--scoring", self.cfg.scoring,
                 "--poll-s", str(self.cfg.poll_s)]
+        if self.cfg.cache_size is not None:
+            argv += ["--cache-size", str(self.cfg.cache_size)]
         if self.cfg.max_batch is not None:
             argv += ["--max-batch", str(self.cfg.max_batch)]
         if self.cfg.federation:
@@ -643,15 +1183,40 @@ class ServingFabric:
                 env["GRAFT_CHAOS"] = spec
         return env
 
-    def _spawn(self, i: int) -> procs.ProcessHandle:
+    def _spawn(self, i: int, *,
+               ready_at_floor: bool = False) -> procs.ProcessHandle:
+        argv = self._replica_argv(i)
+        if ready_at_floor:
+            # handoff successor: spawn() blocking on the handshake now
+            # doubles as the /healthz wait — the handshake only prints
+            # once the successor would answer ready
+            argv = argv + ["--ready-at-floor"]
         handle = procs.ProcessHandle(
-            self._replica_argv(i), env=self._replica_env(i),
+            argv, env=self._replica_env(i),
             ready_timeout_s=self.cfg.ready_timeout_s,
         ).spawn()
         obs.emit("fabric_spawn", replica=i, pid=handle.pid,
                  port=handle.ready.get("port"),
                  generation=handle.ready.get("generation"))
         return handle
+
+    def _push_peers(self) -> None:
+        """Push the fleet topology (id→port) to every replica so each
+        can route cache authority; called after every membership change.
+        Best-effort: a replica that misses a push just keeps its previous
+        view until the next one."""
+        if not self.cfg.peer_cache:
+            return
+        with self._lock:
+            ports = dict(self._ports)
+        doc = {"peers": {str(i): p for i, p in ports.items()},
+               "slots": self.cfg.ring_slots}
+        for i in sorted(ports):
+            try:
+                self._post_json(i, "/peers", doc, 2.0)
+            except Exception:  # noqa: BLE001 — replica catches next push
+                with self._lock:
+                    self._stats["peer_push_errors"] += 1
 
     def _register_with_fleet(self, i: int, port: int) -> None:
         if self.fleet is not None:
@@ -669,6 +1234,7 @@ class ServingFabric:
                 self._handles[i] = handle
                 self._ports[i] = port
             self._register_with_fleet(i, port)
+        self._push_peers()
         if self.fleet is not None:
             self.fleet.start()
             self._fleet_exporter = obs.export.MetricsExporter(
@@ -701,6 +1267,13 @@ class ServingFabric:
             handles = list(self._handles.values())
         for handle in handles:
             handle.terminate(self.cfg.grace_s)
+        with self._lock:
+            anchors, self._anchors = dict(self._anchors), {}
+        for anchor in anchors.values():
+            try:
+                anchor.close()
+            except OSError:
+                pass
         obs.emit("fabric_stop", **self.audit())
         self._started = False
 
@@ -801,6 +1374,8 @@ class ServingFabric:
                 last_err = f"HTTP {exc.code}"
                 with self._lock:
                     self._stats["retries"] += 1
+                    if self._roll_active:
+                        self._stats["roll_retries"] += 1
                 time.sleep(self.cfg.retry_pause_s)
                 continue
             except Exception as exc:  # noqa: BLE001 — dead/hung replica
@@ -808,6 +1383,8 @@ class ServingFabric:
                 last_err = f"{type(exc).__name__}: {exc}"
                 with self._lock:
                     self._stats["retries"] += 1
+                    if self._roll_active:
+                        self._stats["roll_retries"] += 1
                 time.sleep(self.cfg.retry_pause_s)
                 continue
             with self._lock:
@@ -861,7 +1438,14 @@ class ServingFabric:
                              p50_ms=status.get("p50_ms"),
                              p99_ms=status.get("p99_ms"),
                              generation=status.get("generation"),
-                             floor=status.get("floor"))
+                             floor=status.get("floor"),
+                             cache_hits=status.get("cache_hits"),
+                             peer_hits=status.get("peer_hits"),
+                             peer_misses=status.get("peer_misses"),
+                             peek_timeouts=status.get("peek_timeouts"),
+                             fills=status.get("fills"),
+                             breaker_open=status.get("breaker_open"),
+                             peer_stores=status.get("peer_stores"))
                     obs.gauge(f"fabric_replica{i}_requests",
                               float(status.get("requests") or 0))
 
@@ -871,7 +1455,7 @@ class ServingFabric:
         while not self._stop.wait(self.cfg.poll_s):
             for i in self.replica_ids():
                 with self._lock:
-                    if i in self._restarting:
+                    if i in self._restarting or i in self._handoff_ids:
                         continue
                     handle = self._handles.get(i)
                 if handle is None:  # drained since the snapshot
@@ -908,6 +1492,7 @@ class ServingFabric:
                 obs.emit("fabric_respawn", replica=i, pid=fresh.pid,
                          port=fresh.ready.get("port"),
                          recovery_s=round(recovery_s, 3))
+                self._push_peers()  # respawn may have moved the port
 
     # ----------------------------------------------------------- fleet ops
 
@@ -944,10 +1529,20 @@ class ServingFabric:
         """Roll the fleet one replica at a time under a committed floor:
         (1) wait until EVERY replica serves ≥ G, (2) durably commit the
         floor at G — from here no replica may come back below it —
-        (3) TERM → respawn → wait-ready each replica while its siblings
-        keep serving.  Queries in flight on the rolling replica fail
-        typed (ServerShutdown → HTTP 503) and re-dispatch to siblings
-        under their original request ids."""
+        (3) replace each replica while its siblings keep serving.
+
+        With handoff enabled (ISSUE 20) a replica is replaced by spawning
+        its successor into the SAME SO_REUSEPORT listener group FIRST,
+        blocking until the successor's deferred handshake (== healthy at
+        ≥ G), and only then TERMing the predecessor, which stops
+        accepting and drains its in-flight requests to completion — the
+        kernel steers every new connection to the successor throughout,
+        so the roll needs zero sibling retries and the replica never
+        leaves the routing ring.  Without SO_REUSEPORT (or with
+        cfg.handoff off) the PR-17 path runs: TERM → respawn →
+        wait-ready, with in-flight queries failing typed (ServerShutdown
+        → HTTP 503) and re-dispatching to siblings under their original
+        request ids."""
         from page_rank_and_tfidf_using_apache_spark_tpu.serving import (
             segments as sgm,
         )
@@ -961,43 +1556,113 @@ class ServingFabric:
             )
         commit_floor(self.index_dir, G)
         live = self.replica_ids()
-        obs.emit("fabric_roll_start", floor=G, replicas=len(live))
-        for i in live:
+        handoff = self._handoff_enabled()
+        obs.emit("fabric_roll_start", floor=G, replicas=len(live),
+                 handoff=handoff)
+        with self._lock:
+            self._roll_active += 1
+        try:
+            for i in live:
+                if handoff:
+                    self._handoff_replica(i, G)
+                else:
+                    self._roll_replica_retry(i, G, timeout)
+        finally:
             with self._lock:
-                old = self._handles.get(i)
-                if old is None:  # drained while the roll was in flight
-                    continue
-                self._restarting.add(i)
-                self._suspect.add(i)  # route around it immediately
-            t0 = time.monotonic()
-            old.terminate(self.cfg.grace_s)
-            fresh = self._spawn(i)
-            port = int(fresh.ready["port"])
+                self._roll_active -= 1
+        obs.emit("fabric_roll_done", floor=G, handoff=handoff)
+
+    def _handoff_replica(self, i: int, G: int) -> None:
+        """One zero-downtime replacement: successor first, drain second.
+
+        Kill-point discipline: SIGKILL anywhere in this window leaves
+        exactly one generation serving — before the spawn returns, the
+        predecessor still owns the port (a dead half-spawned successor
+        never printed its handshake, and ProcessHandle's spawn timeout
+        reaps it); after the swap, the successor owns it and a killed
+        predecessor just cuts its drain short (its in-flight requests
+        fail typed into the sibling-retry path, same rid)."""
+        with self._lock:
+            old = self._handles.get(i)
+            if old is None:  # drained while the roll was in flight
+                return
+            # suppress supervisor respawn for the window: a predecessor
+            # SIGKILLed mid-handoff must be REPLACED by the swap below,
+            # not raced by a second spawn onto the same port — unlike
+            # _restarting the id stays in routing rotation (the handoff
+            # never stops serving)
+            self._handoff_ids.add(i)
+        t0 = time.monotonic()
+        try:
+            obs.emit("fabric_handoff", replica=i, phase="spawn", floor=G)
+            # ONE chaos-hooked successor spawn (fail/proc_kill here is
+            # the successor-dies-mid-handoff scenario): on failure the
+            # predecessor is untouched and still serving — the roll
+            # aborts with the fleet intact
+            fresh = rx.attempt_once(
+                lambda: self._spawn(i, ready_at_floor=True),
+                site=DRAIN_SITE)
+            obs.emit("fabric_handoff", replica=i, phase="successor_ready",
+                     pid=fresh.pid, floor=G)
             with self._lock:
+                if i not in self._handles:  # drained mid-handoff
+                    fresh.terminate(self.cfg.grace_s)
+                    return
                 self._handles[i] = fresh
-                self._ports[i] = port
-            self._register_with_fleet(i, port)
-            # back in rotation only once it serves ≥ the floor
-            deadline = time.monotonic() + timeout
-            while time.monotonic() < deadline:
-                try:
-                    s = self._get_json(i, "/status", timeout=2.0)
-                    if s.get("ready") and (s.get("generation") or 0) >= G:
-                        break
-                except Exception:  # noqa: BLE001 — still coming up
-                    pass
-                time.sleep(self.cfg.poll_s)
-            else:
-                raise TimeoutError(
-                    f"replica {i} never reached floor {G} after restart"
-                )
+                # port unchanged (the anchor pins it) — no ring or fleet
+                # registration churn; the replica never left rotation
+            self._register_with_fleet(i, int(fresh.ready["port"]))
+            obs.emit("fabric_handoff", replica=i, phase="drain",
+                     pid=old.pid)
+            old.terminate(self.cfg.grace_s)  # SIGTERM → drain → exit
+        finally:
             with self._lock:
-                self._restarting.discard(i)
-                self._suspect.discard(i)
-                self._stats["rolled"] += 1
-            obs.emit("fabric_rolled", replica=i, floor=G,
-                     restart_s=round(time.monotonic() - t0, 3))
-        obs.emit("fabric_roll_done", floor=G)
+                self._handoff_ids.discard(i)
+        handoff_s = time.monotonic() - t0
+        obs.histogram("fabric_handoff_s", handoff_s)
+        with self._lock:
+            self._stats["rolled"] += 1
+        obs.emit("fabric_rolled", replica=i, floor=G, handoff=True,
+                 restart_s=round(handoff_s, 3))
+        self._push_peers()
+
+    def _roll_replica_retry(self, i: int, G: int, timeout: float) -> None:
+        """The PR-17 retry-carried replacement (no SO_REUSEPORT)."""
+        with self._lock:
+            old = self._handles.get(i)
+            if old is None:  # drained while the roll was in flight
+                return
+            self._restarting.add(i)
+            self._suspect.add(i)  # route around it immediately
+        t0 = time.monotonic()
+        old.terminate(self.cfg.grace_s)
+        fresh = self._spawn(i)
+        port = int(fresh.ready["port"])
+        with self._lock:
+            self._handles[i] = fresh
+            self._ports[i] = port
+        self._register_with_fleet(i, port)
+        # back in rotation only once it serves ≥ the floor
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                s = self._get_json(i, "/status", timeout=2.0)
+                if s.get("ready") and (s.get("generation") or 0) >= G:
+                    break
+            except Exception:  # noqa: BLE001 — still coming up
+                pass
+            time.sleep(self.cfg.poll_s)
+        else:
+            raise TimeoutError(
+                f"replica {i} never reached floor {G} after restart"
+            )
+        with self._lock:
+            self._restarting.discard(i)
+            self._suspect.discard(i)
+            self._stats["rolled"] += 1
+        obs.emit("fabric_rolled", replica=i, floor=G, handoff=False,
+                 restart_s=round(time.monotonic() - t0, 3))
+        self._push_peers()
 
     def kill_replica(self, i: int) -> int | None:
         """SIGKILL replica ``i`` (the bench/soak chaos hook); returns the
@@ -1032,6 +1697,8 @@ class ServingFabric:
                 self._stats["scale_ups"] += 1
             self._register_with_fleet(i, port)
             added.append(i)
+        if added:
+            self._push_peers()
         return added
 
     def scale_down(self, n: int = 1) -> list[int]:
@@ -1056,9 +1723,15 @@ class ServingFabric:
                 self._stats["scale_downs"] += 1
             if self.fleet is not None:
                 self.fleet.deregister(str(i))
+            # with handoff enabled the TERM drains in-flight requests to
+            # completion before exit (the replica already left the ring,
+            # so no NEW queries land on it meanwhile)
             handle.terminate(self.cfg.grace_s)
+            self._close_anchor(i)
             obs.emit("fabric_drain", replica=i, pid=handle.pid)
             removed.append(i)
+        if removed:
+            self._push_peers()
         return removed
 
     def scale_to(self, n: int) -> None:
@@ -1080,7 +1753,7 @@ class ServingFabric:
             out = {k: int(self._stats.get(k, 0))
                    for k in ("requests", "delivered", "retries", "failed",
                              "respawns", "rolled", "scale_ups",
-                             "scale_downs")}
+                             "scale_downs", "roll_retries")}
             out["dropped"] = out["failed"]
             out["double_served"] = sum(
                 1 for n in self._audit.values() if n > 1

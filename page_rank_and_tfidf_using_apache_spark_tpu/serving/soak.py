@@ -1003,6 +1003,9 @@ class _FleetSoak:
             self._load_on.set()
         self._scaler_stats: dict | None = None
         self._fleet_final: dict | None = None
+        # sharded-cache tallies summed over the final /status sweep,
+        # snapshotted with the audit before stop() tears the fleet down
+        self._cache_final: dict | None = None
         self.hub = MetricsHub(
             window_s=cfg.window_s,
             latency_slo_s=cfg.slo_p99_ms / 1e3,
@@ -1259,6 +1262,24 @@ class _FleetSoak:
                         "scrape_errors": fs["scrape_errors"],
                     }
             self._last_audit = self.fabric.audit()
+            sts = [s for s in self.fabric.statuses() if s is not None]
+            if sts:
+                hits = sum(int(s.get("peer_hits") or 0) for s in sts)
+                misses = sum(int(s.get("peer_misses") or 0) for s in sts)
+                tos = sum(int(s.get("peek_timeouts") or 0) for s in sts)
+                attempts = hits + misses + tos
+                self._cache_final = {
+                    "peer_hits": hits,
+                    "peer_misses": misses,
+                    "peek_timeouts": tos,
+                    "peer_hit_rate": (round(hits / attempts, 4)
+                                      if attempts else None),
+                    "fills": sum(int(s.get("fills") or 0) for s in sts),
+                    "peer_stores": sum(int(s.get("peer_stores") or 0)
+                                       for s in sts),
+                    "breakers_open": sum(int(s.get("breaker_open") or 0)
+                                         for s in sts),
+                }
             return self._score(actual_s, recoveries, kills, roll)
         finally:
             if scaler is not None:
@@ -1332,7 +1353,15 @@ class _FleetSoak:
                 "roll": roll,
                 "floor": fab.read_floor(self.index_dir),
                 "retries": int(audit.get("retries", 0)),
+                # the handoff's zero-downtime claim, scored: retries the
+                # router attributed to a drain window (0 = no client
+                # ever saw a roll)
+                "roll_retries": int(audit.get("roll_retries", 0)),
             },
+            # sharded result cache (ISSUE 20): cross-replica hit rate
+            # and breaker state over the run — None when the fleet never
+            # exchanged a peek (single replica, or peer_cache off)
+            "cache": self._cache_final,
             # autoscale scenario read-outs (None in the classic fleet
             # soak): the scaler's decision tallies, the router audit's
             # membership-change counts, and the final fleet board

@@ -105,6 +105,17 @@ GRAFT_ENV_KNOBS: frozenset = frozenset(
         "GRAFT_AUTOSCALE_COOLDOWN_S",  # serving/fabric.py AutoscaleConfig:
         # minimum seconds between scale actions (default 10; the flap
         # gate in tools/trace_diff.py leans on this)
+        "GRAFT_CACHE_PEEK_DEADLINE_S",  # serving/fabric.py: hard bound on
+        # one owner cache-peek round-trip (default 0.25s) — the most a
+        # slow/partitioned peer can ever add to a request's latency
+        "GRAFT_CACHE_BREAKER_TRIP",  # serving/fabric.py: consecutive peer
+        # timeouts before that peer's circuit breaker opens (default 3)
+        "GRAFT_CACHE_BREAKER_PROBE_S",  # serving/fabric.py: seconds an
+        # open breaker waits before letting one half-open probe through
+        # (default 2.0)
+        "GRAFT_DRAIN_BUDGET_S",  # tools/ci.sh wall-clock budget for the
+        # drain-handoff kill-matrix smoke (SIGKILL pre-drain / mid-drain /
+        # post-successor-healthy; read in bash; default 40s)
     }
 )
 
@@ -251,6 +262,21 @@ THREAD_REGISTRY: tuple = (
      "page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py",
      # scale_up/scale_down swap membership + ring under the router's lock
      ("ServingFabric._lock",)),
+    ("fabric-peer-peek",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py",
+     ()),  # disposable bounded-deadline cache peek: one HTTP round-trip
+    # into a result cell, abandoned past the deadline (ISSUE 20)
+    ("fabric-peer-fill",
+     "page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py",
+     # owner write-back drain: breaker + peer tallies under the replica's
+     # peer lock, never the serving hot path's _lock
+     ("_Replica._peer_lock",)),
+    ("bench-roll-load",
+     "bench.py",
+     # closed-loop load during the bench child's rolling-restart probe;
+     # fabric.query folds delivery stats under the router's own lock
+     ("page_rank_and_tfidf_using_apache_spark_tpu/serving/fabric.py::"
+      "ServingFabric._lock",)),
 )
 
 

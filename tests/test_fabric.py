@@ -369,14 +369,21 @@ def test_router_bad_request_raises_value_error(tmp_path):
 
 def test_router_affinity_routes_same_key_to_same_replica(tmp_path):
     """The sharded-cache property end to end: the same logical query
-    (same affinity key) always lands on the same healthy replica."""
+    (same affinity key) always lands on the same healthy replica.
+
+    Runs under its own (empty) chaos plan: a ``fabric_route`` fault from
+    the ambient tools/chaos.sh gate makes the router CORRECTLY reroute
+    one hop to the sibling, which is exactly what the strict (6,0)/(0,6)
+    stickiness assertion exists to rule out in the fault-free case —
+    retry-under-chaos has its own tests above."""
     seen0: list[str] = []
     seen1: list[str] = []
     stubs = _StubFleet([_ok_handler(0, seen0), _ok_handler(1, seen1)])
     try:
         fab = _stub_router(tmp_path, stubs.ports(), retry_limit=4)
-        for _ in range(6):
-            fab.query(["stable", "key"])
+        with chaos.inject(""):
+            for _ in range(6):
+                fab.query(["stable", "key"])
         assert (len(seen0), len(seen1)) in ((6, 0), (0, 6))
     finally:
         stubs.stop()

@@ -234,35 +234,42 @@ def test_scrape_staleness_labels_never_drops():
 def test_merge_under_churn():
     """Replicas joining and draining between scrapes: a deregistered
     replica's contribution leaves with it, a layout-drifted replica is a
-    recorded per-replica merge error, and the board never raises."""
-    sf = _StubFleet(3)
-    for i, r in enumerate(sf.hubs):
-        for _ in range(i + 1):
-            sf.hubs[r].observe_request(0.01, ok=True)
-    sf.fleet.scrape_once()
-    assert (sf.fleet.snapshot()["counters"]["serve.requests"]["total"]
-            == 1 + 2 + 3)
+    recorded per-replica merge error, and the board never raises.
 
-    # drain replica 0: its 1 request leaves the aggregate
-    sf.fleet.deregister("0")
-    snap = sf.fleet.snapshot()
-    assert snap["fleet"]["replicas"] == ["1", "2"]
-    assert snap["counters"]["serve.requests"]["total"] == 2 + 3
+    Runs under its own (empty) chaos plan: the exact counter arithmetic
+    below is the point of the test, and an ambient ``fed_scrape`` fault
+    (the tools/chaos.sh gate) would CORRECTLY drop a first-scrape
+    contribution — that containment behavior has its own test right
+    below and a full-fabric scenario in the gate itself."""
+    with chaos.inject(""):
+        sf = _StubFleet(3)
+        for i, r in enumerate(sf.hubs):
+            for _ in range(i + 1):
+                sf.hubs[r].observe_request(0.01, ok=True)
+        sf.fleet.scrape_once()
+        assert (sf.fleet.snapshot()["counters"]["serve.requests"]["total"]
+                == 1 + 2 + 3)
 
-    # a mixed-version replica whose mergeable has a different window is
-    # a per-replica merge error, not a dead board
-    sf.hubs["3"] = MetricsHub(window_s=30.0, clock=sf.clk)
-    sf.hubs["3"].observe_request(0.01, ok=True)
-    sf.alive["3"] = True
-    sf.fleet.register("3", "http://stub/3")
-    sf.fleet.scrape_once()
-    snap = sf.fleet.snapshot()
-    assert "3" in snap["fleet"]["merge_errors"]
-    assert snap["counters"]["serve.requests"]["total"] == 2 + 3
+        # drain replica 0: its 1 request leaves the aggregate
+        sf.fleet.deregister("0")
+        snap = sf.fleet.snapshot()
+        assert snap["fleet"]["replicas"] == ["1", "2"]
+        assert snap["counters"]["serve.requests"]["total"] == 2 + 3
 
-    # churn race: a replica deregistered mid-scrape must not resurrect
-    sf.fleet.deregister("3")
-    assert "3" not in sf.fleet.snapshot()["fleet"]["replicas"]
+        # a mixed-version replica whose mergeable has a different window
+        # is a per-replica merge error, not a dead board
+        sf.hubs["3"] = MetricsHub(window_s=30.0, clock=sf.clk)
+        sf.hubs["3"].observe_request(0.01, ok=True)
+        sf.alive["3"] = True
+        sf.fleet.register("3", "http://stub/3")
+        sf.fleet.scrape_once()
+        snap = sf.fleet.snapshot()
+        assert "3" in snap["fleet"]["merge_errors"]
+        assert snap["counters"]["serve.requests"]["total"] == 2 + 3
+
+        # churn race: a replica deregistered mid-scrape must not resurrect
+        sf.fleet.deregister("3")
+        assert "3" not in sf.fleet.snapshot()["fleet"]["replicas"]
 
 
 def test_scrape_chaos_never_blocks_the_board():

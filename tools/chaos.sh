@@ -594,3 +594,136 @@ print("scrape-chaos scenario: OK — 26/26 delivered under "
       "labeled stale (never dropped), aggregate kept last-known state, "
       "fleet recovered to fresh, dropped=0 double_served=0")
 EOF
+
+
+# cache-partition scenario (ISSUE 20): the sharded result cache must
+# degrade to LOCAL COMPUTE, never to blocking or wrong bytes.  Replica 1
+# boots with cache_peek:net_partition@1 + net_hang@2:2000 +
+# cache_fill:net_partition@1+ in ITS environment (replica_chaos): its
+# first peek at the owner partitions, the consecutive fill failure trips
+# the per-peer breaker within GRAFT_CACHE_BREAKER_TRIP=2, later queries
+# fail fast (no peer I/O), the half-open probe eats the 2s hang bounded
+# by the 0.4s peek deadline, and the NEXT probe recloses the breaker
+# with a real peer hit — byte-identical to the owner's answer.  Routed
+# traffic never notices: audit dropped=0 / double_served=0.
+echo "== chaos: sharded-cache peer partition/hang (cache_peek / cache_fill) =="
+python - <<'EOF'
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path.cwd()))
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import fabric
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import segments as sgm
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    Bm25Config,
+    TfidfConfig,
+)
+
+os.environ["GRAFT_CACHE_BREAKER_TRIP"] = "2"
+os.environ["GRAFT_CACHE_BREAKER_PROBE_S"] = "1.0"
+os.environ["GRAFT_CACHE_PEEK_DEADLINE_S"] = "0.4"
+
+scfg = TfidfConfig(vocab_bits=10)
+docs = ["node edge graph rank walk", "graph node directed edge weight",
+        "rank walk teleport damping node", "edge list sparse matrix graph"]
+tmp = tempfile.mkdtemp(prefix="chaos-cache-")
+out = run_tfidf(docs, scfg)
+ref = sgm.seal_segment(tmp, out, scfg, doc_base=0,
+                       ranks=np.ones(out.n_docs, np.float32),
+                       bm25=Bm25Config())
+sgm.commit_append(tmp, ref, scfg.config_hash())
+
+SPEC = ("cache_peek:net_partition@1;cache_peek:net_hang@2:2000;"
+        "cache_fill:net_partition@1+")
+fab = fabric.ServingFabric(tmp, fabric.FabricConfig(
+    replicas=2, poll_s=0.1, health_period_s=0.2, retry_limit=100,
+    retry_pause_s=0.1, grace_s=10.0, federation=False,
+    replica_chaos=((1, SPEC),),
+))
+
+def post(port, path, doc, timeout=5.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+def status(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/status", timeout=5.0) as resp:
+        return json.loads(resp.read())
+
+# single-word keys the cache ring routes to replica 0 (the owner):
+# driving them at replica 1 directly exercises the non-owner peek path
+ring = fabric._Ring([0, 1], 64)
+owned = [[w] for w in (f"k{i}" for i in range(200))
+         if ring.route(fabric.affinity_key([w], "tfidf"))[0] == 0]
+assert len(owned) >= 4, len(owned)
+k_hot, k_open, k_hang, k_heal = owned[0], owned[1], owned[2], owned[3]
+
+with fab:
+    p1 = fab._ports[1]
+    # warm the owner through the router (affinity routes k_hot to 0)
+    ref_scores, ref_docs = fab.query(k_hot)
+
+    # peek#1 partitions, the consecutive fill failure trips the breaker
+    t0 = time.perf_counter()
+    r1 = post(p1, "/query", {"rid": "cc-1", "terms": k_hot,
+                             "ranker": "tfidf"})
+    assert time.perf_counter() - t0 < 2.0  # bounded: deadline + compute
+    assert r1["scores"] == [float(s) for s in ref_scores], r1
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and status(p1)["breaker_open"] == 0:
+        time.sleep(0.05)  # the tripping fill is asynchronous
+    st = status(p1)
+    assert st["breaker_open"] == 1, st
+    assert st["peek_timeouts"] >= 1, st
+
+    # breaker open: no peer I/O at all — fast local compute, and the
+    # routed path keeps serving correct bytes mid-partition
+    t0 = time.perf_counter()
+    post(p1, "/query", {"rid": "cc-2", "terms": k_open, "ranker": "tfidf"})
+    assert time.perf_counter() - t0 < 1.0
+    for _ in range(5):
+        scores, _ = fab.query(k_hot)
+        assert [float(s) for s in scores] == [float(s) for s in ref_scores]
+
+    # half-open probe #1 eats the 2s hang but blocks only for the 0.4s
+    # peek deadline before falling back to local compute (re-opens)
+    time.sleep(1.2)
+    t0 = time.perf_counter()
+    post(p1, "/query", {"rid": "cc-3", "terms": k_hang, "ranker": "tfidf"})
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 1.5, elapsed  # NOT the 2s hang
+    st = status(p1)
+    assert st["breaker_open"] == 1, st
+    assert st["peek_timeouts"] >= 2, st
+
+    # half-open probe #2 is clean: warms through the owner, recloses
+    time.sleep(1.2)
+    fab.query(k_heal)  # router warms the owner first
+    r4 = post(p1, "/query", {"rid": "cc-4", "terms": k_heal,
+                             "ranker": "tfidf"})
+    st = status(p1)
+    assert st["breaker_open"] == 0, st
+    assert st["peer_hits"] >= 1, st
+    audit = fab.audit()
+
+assert audit["dropped"] == 0, audit
+assert audit["double_served"] == 0, audit
+assert audit["failed"] == 0, audit
+
+print("cache-partition scenario: OK — non-owner served correct bytes "
+      "under cache_peek:net_partition/net_hang + cache_fill:net_partition, "
+      "blocking bounded by the 0.4s peek deadline (2s hang absorbed), "
+      "breaker tripped at 2 consecutive failures, half-open probe "
+      "reclosed it with a real peer hit, dropped=0 double_served=0")
+EOF

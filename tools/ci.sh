@@ -225,6 +225,192 @@ if [ "$dt" -gt "${GRAFT_PROTO_BUDGET_S:-10}" ]; then
     exit 1
 fi
 
+echo "== drain kill-matrix smoke (SIGKILL at 3 handoff points, budget ${GRAFT_DRAIN_BUDGET_S:-40}s) =="
+# The drain handoff's kill-point discipline, exercised for real: a
+# 1-replica fleet rolls via SO_REUSEPORT socket handoff while SIGKILL
+# lands (a) on the predecessor pre-drain (mid-successor-spawn), (b) on
+# the predecessor mid-drain (right after the swap), (c) on the healthy
+# successor post-roll.  After every point exactly ONE process serves the
+# pinned port — repeated /status polls see a single pid — and the
+# closed-loop audit stays dropped=0 / double_served=0.
+t0=$(date +%s)
+if env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python - > /tmp/_drain_matrix.log 2>&1 <<'EOF'
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path.cwd()))
+import numpy as np
+
+from page_rank_and_tfidf_using_apache_spark_tpu.models.tfidf import run_tfidf
+from page_rank_and_tfidf_using_apache_spark_tpu.obs.export import (
+    reuse_port_supported,
+)
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import fabric
+from page_rank_and_tfidf_using_apache_spark_tpu.serving import segments as sgm
+from page_rank_and_tfidf_using_apache_spark_tpu.utils.config import (
+    Bm25Config,
+    TfidfConfig,
+)
+
+if not reuse_port_supported():
+    print("drain kill-matrix: SKIP (platform lacks SO_REUSEPORT)")
+    sys.exit(0)
+
+scfg = TfidfConfig(vocab_bits=10)
+docs = ["node edge graph rank walk", "graph node directed edge weight",
+        "rank walk teleport damping node", "edge list sparse matrix graph"]
+tmp = tempfile.mkdtemp(prefix="drain-matrix-")
+out = run_tfidf(docs, scfg)
+ref = sgm.seal_segment(tmp, out, scfg, doc_base=0,
+                       ranks=np.ones(out.n_docs, np.float32),
+                       bm25=Bm25Config())
+sgm.commit_append(tmp, ref, scfg.config_hash())
+
+fab = fabric.ServingFabric(tmp, fabric.FabricConfig(
+    replicas=1, poll_s=0.1, health_period_s=0.2, retry_limit=200,
+    retry_pause_s=0.1, grace_s=10.0, federation=False,
+))
+
+
+def kill(pid):
+    try:
+        os.kill(pid, signal.SIGKILL)
+        return True
+    except ProcessLookupError:
+        return False  # already exited — the point degenerates upward
+
+
+def settle(expect_new_vs=None, timeout=30.0):
+    """Wait until exactly one live serving process, return its pid."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        h = fab._handles.get(0)
+        if h is not None and h.alive() and \
+                (expect_new_vs is None or h.pid != expect_new_vs):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{fab._ports[0]}/status",
+                        timeout=2.0) as resp:
+                    st = json.loads(resp.read())
+                if st["ready"]:
+                    return h.pid
+            except OSError:
+                pass
+        time.sleep(0.1)
+    raise AssertionError("no healthy replica settled in time")
+
+
+def poll_pids(n=15):
+    pids = set()
+    for _ in range(n):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fab._ports[0]}/status",
+                timeout=2.0) as resp:
+            pids.add(json.loads(resp.read())["pid"])
+        time.sleep(0.02)
+    return pids
+
+
+def roll_with_kill(trigger):
+    """Roll in a thread; `trigger(old_pid)` decides when to SIGKILL."""
+    old_pid = fab._handles[0].pid
+    errs = []
+
+    def run():
+        try:
+            fab.rolling_restart(timeout=60.0)
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errs.append(exc)
+
+    t = threading.Thread(target=run)
+    t.start()
+    trigger(old_pid)
+    t.join(90.0)
+    assert not t.is_alive(), "roll wedged"
+    return old_pid, errs
+
+
+with fab:
+    stop = threading.Event()
+    failures = []
+
+    def load():
+        while not stop.is_set():
+            try:
+                fab.query(["node", "graph"])
+            except Exception as exc:  # noqa: BLE001 — audited below
+                failures.append(exc)
+
+    loader = threading.Thread(target=load, daemon=True)
+    loader.start()
+    try:
+        # (a) pre-drain: predecessor dies while the successor is still
+        # spawning — the handoff swap must replace it, not race a
+        # supervisor respawn onto the same port
+        old, errs = roll_with_kill(lambda pid: kill(pid))
+        assert not errs, errs
+        pid_a = settle(expect_new_vs=old)
+        assert poll_pids() == {pid_a}, "more than one listener serving"
+
+        # (b) mid-drain: SIGKILL the predecessor right after the swap
+        # (its drain is cut short; in-flight requests retry typed)
+        def mid_drain(pid):
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                h = fab._handles.get(0)
+                if h is not None and h.pid != pid:
+                    break
+                time.sleep(0.01)
+            kill(pid)
+
+        old, errs = roll_with_kill(mid_drain)
+        assert not errs, errs
+        pid_b = settle(expect_new_vs=old)
+        assert poll_pids() == {pid_b}, "more than one listener serving"
+
+        # (c) post-successor-healthy: the freshly rolled replica dies —
+        # ordinary unplanned failure, the supervisor path takes it
+        old, errs = roll_with_kill(lambda pid: None)
+        assert not errs, errs
+        pid_c = settle(expect_new_vs=old)
+        kill(pid_c)
+        pid_d = settle(expect_new_vs=pid_c)
+        assert poll_pids() == {pid_d}, "more than one listener serving"
+    finally:
+        stop.set()
+        loader.join(10.0)
+    audit = fab.audit()
+
+assert not failures, failures[:3]
+assert audit["dropped"] == 0, audit
+assert audit["double_served"] == 0, audit
+assert audit["rolled"] == 3, audit
+print("drain kill-matrix: OK — SIGKILL pre-drain / mid-drain / "
+      "post-successor left exactly one listener each time "
+      f"({audit['requests']} closed-loop requests, dropped=0 "
+      "double_served=0)")
+EOF
+then
+    tail -1 /tmp/_drain_matrix.log
+else
+    echo "FAIL: drain kill-matrix smoke; its output:" >&2
+    cat /tmp/_drain_matrix.log >&2
+    exit 1
+fi
+dt=$(( $(date +%s) - t0 ))
+echo "drain kill-matrix: ${dt}s"
+if [ "$dt" -gt "${GRAFT_DRAIN_BUDGET_S:-40}" ]; then
+    echo "FAIL: drain kill-matrix exceeded its ${GRAFT_DRAIN_BUDGET_S:-40}s budget (${dt}s)" >&2
+    exit 1
+fi
+
 echo "== trace-diff gate (per-phase regression across committed rounds) =="
 # Compare the two newest committed BENCH rounds: a per-phase wall-time
 # regression past GRAFT_TRACE_DIFF_THRESHOLD (default 35%) in the

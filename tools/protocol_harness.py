@@ -93,7 +93,13 @@ _DOCS = [
 ]
 
 # Template values for building a VALID request body from declared keys.
-_REQUEST_VALUES = {"terms": ["node"], "ranker": "tfidf"}
+# (The harness bodies carry the UNION of every row's droppable keys, so
+# every declared key needs a value the strictest handler parses: the
+# /cache/fill coercions want numeric lists and an int generation, and
+# /peers wants a str→int map — {} keeps the fixture topology peer-free.)
+_REQUEST_VALUES = {"terms": ["node"], "ranker": "tfidf",
+                   "scores": [1.0], "docs": [0], "generation": 1,
+                   "peers": {}, "slots": 64}
 
 # Dispatcher catch-alls: admissible on every endpoint without declaring
 # them per row (unrouted path/method -> 404, handler crash -> 500).
@@ -187,6 +193,9 @@ def run_harness(timeout_s: float = 5.0) -> dict:
     exporter = MetricsExporter(MetricsHub(), port=0, routes={
         ("POST", "/query"): rep.handle_query,
         ("GET", "/status"): rep.handle_status,
+        ("POST", "/cache/peek"): rep.handle_cache_peek,
+        ("POST", "/cache/fill"): rep.handle_cache_fill,
+        ("POST", "/peers"): rep.handle_peers,
     }).start()
     port = exporter.port
 
@@ -289,7 +298,7 @@ def run_harness(timeout_s: float = 5.0) -> dict:
                                   retry_pause_s=0.05,
                                   request_timeout_s=timeout_s)
         fab = fabric.ServingFabric(tmp, cfg)
-        fab._ports = [port]  # routed without start(): no child processes
+        fab._ports = {0: port}  # routed without start(): no child processes
         t0 = time.monotonic()
         try:
             fab.query(["node"], timeout=timeout_s)

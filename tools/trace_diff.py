@@ -309,6 +309,14 @@ def load_fabric(path: str) -> dict | None:
         # host-context annotation (ISSUE 18): cpus < replicas means the
         # n4/n1 scaling ratio measured contention, not scaling
         "nongating": bool(extra.get("fabric_scaling_nongating")),
+        # drain-handoff + sharded-cache numbers (ISSUE 20), all absent/
+        # None on rounds predating them: retries attributed to the roll
+        # window (0 when the socket handoff carries every roll), the
+        # fleet's cross-replica cache hit rate under the skewed
+        # workload, and the measured A/B speedup from peer caching
+        "roll_retries": extra.get("fabric_roll_retries"),
+        "peer_hit_rate": extra.get("cache_peer_hit_rate"),
+        "cache_speedup": extra.get("cache_speedup_skewed"),
     }
 
 
@@ -382,6 +390,36 @@ def diff_fabric(
                 "why": f"cross-process {key} requests appeared — an "
                        "invariant, not a knob",
             })
+    # Roll-attributed retries (ISSUE 20): the drain handoff's whole
+    # claim is that a rolling restart needs NO sibling retries — any
+    # appearance (or growth, for rounds that already paid some) means
+    # the handoff stopped carrying the roll.  Old-round None arms the
+    # invariant at 0: the first handoff round must come in clean.
+    o_v, n_v = old.get("roll_retries"), new.get("roll_retries")
+    if isinstance(n_v, int) and \
+            n_v > (o_v if isinstance(o_v, int) else 0):
+        rows.append({
+            "key": "fabric.roll_retries",
+            "old": o_v,
+            "new": n_v,
+            "why": "retries were attributed to the rolling-restart "
+                   "window — the drain handoff stopped carrying the "
+                   "roll (an invariant, not a knob)",
+        })
+    # Cross-replica cache hit rate (ISSUE 20): the sharded cache's
+    # skewed-workload peer hit rate may not fall relatively past
+    # ``threshold``.  None on either side (failed fabric child, or a
+    # round predating the sharded cache) skips the comparison.
+    o_h, n_h = old.get("peer_hit_rate"), new.get("peer_hit_rate")
+    if (isinstance(o_h, (int, float)) and isinstance(n_h, (int, float))
+            and o_h > 0 and n_h < o_h * (1.0 - threshold)):
+        rows.append({
+            "key": "fabric.cache_peer_hit_rate",
+            "old": o_h,
+            "new": n_h,
+            "why": f"cross-replica cache hit rate fell to "
+                   f"{n_h / max(o_h, 1e-9):.2f}x of the old round",
+        })
     return rows
 
 

@@ -340,12 +340,83 @@ def report(path: str) -> dict[str, Any]:
                 for e in fab_events if e["kind"] == "fabric_floor"
             ],
             "rolls": sum(e["kind"] == "fabric_rolled" for e in fab_events),
+            # Drain-handoff forensics (ISSUE 20): rolls split by
+            # mechanism (socket handoff vs the retry-carried fallback)
+            # and the per-replica handoff phase timeline — spawn →
+            # successor_ready → drain on the router side, the replica's
+            # own drain_begin/drain_done interleaved when its trace is
+            # folded in.  `totals.roll_retries` (from fabric_stop) is
+            # the handoff acceptance gate: 0 when every roll handed off.
+            "handoff_rolls": sum(
+                e["kind"] == "fabric_rolled" and bool(e.get("handoff"))
+                for e in fab_events),
+            "retry_rolls": sum(
+                e["kind"] == "fabric_rolled" and not e.get("handoff")
+                for e in fab_events),
+            "drain_timeline": sorted(
+                [{"replica": e.get("replica"), "phase": e.get("phase"),
+                  "pid": e.get("pid"), "t_rel": round(e["t"] - t0, 3)}
+                 for e in fab_events if e["kind"] == "fabric_handoff"]
+                + [{"replica": e.get("replica"), "phase": "drain_begin",
+                    "pid": e.get("pid"), "t_rel": round(e["t"] - t0, 3)}
+                   for e in fab_events
+                   if e["kind"] == "fabric_drain_begin"]
+                + [{"replica": e.get("replica"), "phase": "drain_done",
+                    "drain_s": e.get("drain_s"),
+                    "t_rel": round(e["t"] - t0, 3)}
+                   for e in fab_events
+                   if e["kind"] == "fabric_drain_done"],
+                key=lambda row: row["t_rel"]),
             "replica_stats": replica_stats,
             "totals": (
                 {k: v for k, v in stop_evt.items()
                  if k not in ("kind", "t", "wall", "thread", "seq")}
                 if stop_evt else None
             ),
+        }
+
+    # Sharded-cache accounting (ISSUE 20): per-replica local/peer hit
+    # rates folded from the router's periodic /status scrape, the
+    # breaker transition timeline (cache_breaker events), and the peek
+    # latency histogram from the run-end summary.  peer_hit_rate is
+    # peer_hits over peek ATTEMPTS (hits + misses + timeouts) — skipped
+    # open-breaker peeks never reached the wire and are not attempts.
+    cache = None
+    breaker_events = [e for e in events if e.get("kind") == "cache_breaker"]
+    cache_stats: dict[Any, dict[str, Any]] = {}
+    for e in events:
+        if e.get("kind") == "fabric_replica_stats" and \
+                e.get("peer_hits") is not None:
+            hits = int(e.get("cache_hits") or 0)
+            ph = int(e.get("peer_hits") or 0)
+            pm = int(e.get("peer_misses") or 0)
+            pt = int(e.get("peek_timeouts") or 0)
+            reqs = int(e.get("requests") or 0)
+            cache_stats[e.get("replica")] = {
+                "requests": reqs,
+                "local_hits": hits,
+                "local_hit_rate": round(hits / reqs, 4) if reqs else None,
+                "peer_hits": ph,
+                "peer_misses": pm,
+                "peek_timeouts": pt,
+                "peer_hit_rate": (round(ph / (ph + pm + pt), 4)
+                                  if ph + pm + pt else None),
+                "fills": int(e.get("fills") or 0),
+                "peer_stores": int(e.get("peer_stores") or 0),
+                "breaker_open": e.get("breaker_open"),
+            }
+    if cache_stats or breaker_events:
+        summary_h = ((run_end or {}).get("summary") or {}).get(
+            "histograms") or {}
+        cache = {
+            "replica_stats": cache_stats,
+            "peek_latency": summary_h.get("cache_peek_s"),
+            "breaker_transitions": [
+                {"replica": e.get("replica"), "peer": e.get("peer"),
+                 "old": e.get("old"), "new": e.get("new"),
+                 "t_rel": round(e["t"] - t0, 3)}
+                for e in breaker_events
+            ],
         }
 
     # Autoscaling timeline (ISSUE 19): the burn-rate autoscaler publishes
@@ -398,6 +469,7 @@ def report(path: str) -> dict[str, Any]:
         "serving": serving,
         "slo": slo,
         "fabric": fabric,
+        "cache": cache,
         "autoscale": autoscale,
         "events": len(events),
         "bad_lines": bad,
@@ -496,6 +568,7 @@ def stitch(root: str) -> dict[str, Any]:
             "serving": rep.get("serving"),
             "slo": rep.get("slo"),
             "fabric": rep.get("fabric"),
+            "cache": rep.get("cache"),
         })
         tree["wall_secs"] = round(tree["wall_secs"] + rep["wall_secs"], 3)
         tree["retries"] += sum(rep["retries"].values())
@@ -657,14 +730,50 @@ def render_human(rep: dict[str, Any]) -> str:
             lines.append("  floor timeline: " + " -> ".join(
                 f"{f['floor']}@+{f['t_rel']}s" for f in fb["floor_timeline"]
             ))
+        if fb.get("drain_timeline"):
+            lines.append(
+                f"  drain: {fb.get('handoff_rolls', 0)} handoff roll(s) / "
+                f"{fb.get('retry_rolls', 0)} retry roll(s); timeline: "
+                + " -> ".join(
+                    f"r{d.get('replica')}:{d.get('phase')}@+{d['t_rel']}s"
+                    for d in fb["drain_timeline"]
+                )
+            )
         if fb.get("totals"):
             t = fb["totals"]
             lines.append(
                 f"  totals: {t.get('requests')} routed, "
                 f"{t.get('delivered')} delivered, "
-                f"{t.get('retries', 0)} retried, "
+                f"{t.get('retries', 0)} retried "
+                f"({t.get('roll_retries', 0)} during rolls), "
                 f"{t.get('failed', 0)} dropped, "
                 f"{t.get('double_served', 0)} double-served"
+            )
+    if rep.get("cache"):
+        ca = rep["cache"]
+        lines.append(
+            f"cache: {len(ca['replica_stats'])} replica(s) reporting, "
+            f"{len(ca['breaker_transitions'])} breaker transition(s)"
+        )
+        for rid in sorted(ca["replica_stats"], key=str):
+            st = ca["replica_stats"][rid]
+            lines.append(
+                f"  replica {rid}: local hit rate "
+                f"{st.get('local_hit_rate')}, peer hit rate "
+                f"{st.get('peer_hit_rate')} ({st.get('peer_hits')} hit / "
+                f"{st.get('peer_misses')} miss / "
+                f"{st.get('peek_timeouts')} timeout), "
+                f"{st.get('fills')} fill(s) out, "
+                f"{st.get('peer_stores')} store(s) in, "
+                f"{st.get('breaker_open')} breaker(s) open"
+            )
+        if ca.get("peek_latency"):
+            lines.append(f"  peek latency: {ca['peek_latency']}")
+        for b in ca["breaker_transitions"]:
+            lines.append(
+                f"  breaker: replica {b.get('replica')} -> peer "
+                f"{b.get('peer')}: {b.get('old')} -> {b.get('new')} "
+                f"at +{b['t_rel']}s"
             )
     if rep.get("autoscale"):
         a = rep["autoscale"]
